@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path): JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8
+must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,76 @@
+"""Curve + pairing oracle tests."""
+
+import random
+
+from distributed_plonk_tpu import curve as C
+from distributed_plonk_tpu.constants import R_MOD
+from distributed_plonk_tpu.fields import fq12_pow, FQ12_ONE
+
+rng = random.Random(0xC1C1E)
+
+
+def _msb_mul_affine(p, k):
+    t = None
+    for b in bin(k)[2:]:
+        t = C.g1_add_affine(t, t) if t is not None else None
+        if b == "1":
+            t = C.g1_add_affine(t, p)
+    return t
+
+
+def test_generators_on_curve_and_order():
+    assert C.g1_is_on_curve(C.G1_GEN)
+    assert C.g2_is_on_curve(C.G2_GEN)
+    # unreduced scalar: r * G == O (g1_mul reduces mod r, so do it manually)
+    assert _msb_mul_affine(C.G1_GEN, R_MOD) is None
+    assert C.g2_mul(C.G2_GEN, R_MOD - 1) == C.g2_neg(C.G2_GEN)
+
+
+def test_g1_jacobian_vs_affine():
+    p = C.G1_GEN
+    for k in [2, 3, 5, 17, 12345, rng.randrange(1 << 64)]:
+        assert C.g1_mul(p, k) == _msb_mul_affine(p, k)
+
+
+def test_g1_add_edge_cases():
+    p = C.G1_GEN
+    assert C.g1_add_affine(p, None) == p
+    assert C.g1_add_affine(None, p) == p
+    assert C.g1_add_affine(p, C.g1_neg(p)) is None
+    assert C.g1_add_affine(p, p) == C.g1_mul(p, 2)
+    j = C.g1_jac_add(C.g1_to_jac(p), (1, 1, 0))
+    assert C.g1_from_jac(j) == p
+
+
+def test_msm_oracle_matches_naive():
+    n = 16
+    pts = [C.g1_mul(C.G1_GEN, rng.randrange(R_MOD)) for _ in range(n)]
+    pts[3] = None  # infinity padding, as the reference's SRS zero-pad
+    scalars = [rng.randrange(R_MOD) for _ in range(n)]
+    scalars[5] = 0
+    naive = None
+    for p, s in zip(pts, scalars):
+        if p is not None:
+            naive = C.g1_add_affine(naive, C.g1_mul(p, s))
+    assert C.g1_msm(pts, scalars) == naive
+
+
+def test_pairing_bilinear():
+    a, b = 1234567, 7654321
+    e = C.pairing(C.G1_GEN, C.G2_GEN)
+    assert e != FQ12_ONE
+    assert C.pairing(C.g1_mul(C.G1_GEN, a), C.g2_mul(C.G2_GEN, b)) == fq12_pow(e, a * b % R_MOD)
+
+
+def test_pairing_check():
+    k = 424242
+    good = [
+        (C.g1_mul(C.G1_GEN, k), C.G2_GEN),
+        (C.g1_neg(C.G1_GEN), C.g2_mul(C.G2_GEN, k)),
+    ]
+    assert C.pairing_check(good)
+    bad = [
+        (C.g1_mul(C.G1_GEN, k), C.G2_GEN),
+        (C.g1_neg(C.G1_GEN), C.g2_mul(C.G2_GEN, k + 1)),
+    ]
+    assert not C.pairing_check(bad)

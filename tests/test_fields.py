@@ -1,0 +1,72 @@
+"""Field-arithmetic oracle tests (constants, tower laws, batch inverse)."""
+
+import random
+
+from distributed_plonk_tpu import fields as F
+from distributed_plonk_tpu.constants import (
+    R_MOD,
+    Q_MOD,
+    BLS_Z,
+    FR_ROOT_OF_UNITY,
+    FR_TWO_ADICITY,
+    FR_MONT_R,
+    FR_MONT_INV,
+    FQ_MONT_R,
+    FQ_MONT_INV,
+)
+
+rng = random.Random(0xF1E1D)
+
+
+def test_moduli_match_bls_parameterisation():
+    assert R_MOD == BLS_Z ** 4 - BLS_Z ** 2 + 1
+    assert Q_MOD == (BLS_Z - 1) ** 2 * R_MOD // 3 + BLS_Z
+    assert R_MOD.bit_length() == 255
+    assert Q_MOD.bit_length() == 381
+
+
+def test_root_of_unity():
+    assert pow(FR_ROOT_OF_UNITY, 1 << FR_TWO_ADICITY, R_MOD) == 1
+    assert pow(FR_ROOT_OF_UNITY, 1 << (FR_TWO_ADICITY - 1), R_MOD) != 1
+    w8 = F.fr_root_of_unity(8)
+    assert pow(w8, 8, R_MOD) == 1 and pow(w8, 4, R_MOD) != 1
+
+
+def test_montgomery_constants():
+    assert FR_MONT_R == (1 << 256) % R_MOD
+    assert (R_MOD * FR_MONT_INV) % (1 << 256) == (1 << 256) - 1
+    assert (Q_MOD * FQ_MONT_INV) % (1 << 384) == (1 << 384) - 1
+    assert FQ_MONT_R == (1 << 384) % Q_MOD
+
+
+def test_fr_field_laws():
+    for _ in range(100):
+        a, b, c = (rng.randrange(R_MOD) for _ in range(3))
+        assert F.fr_mul(F.fr_mul(a, b), c) == F.fr_mul(a, F.fr_mul(b, c))
+        assert F.fr_mul(a, F.fr_add(b, c)) == F.fr_add(F.fr_mul(a, b), F.fr_mul(a, c))
+        if a != 0:
+            assert F.fr_mul(a, F.fr_inv(a)) == 1
+
+
+def test_batch_inverse():
+    vals = [rng.randrange(1, R_MOD) for _ in range(257)]
+    invs = F.batch_inverse(vals, R_MOD)
+    for v, i in zip(vals, invs):
+        assert v * i % R_MOD == 1
+
+
+def test_fq12_tower():
+    def rfq2():
+        return (rng.randrange(Q_MOD), rng.randrange(Q_MOD))
+
+    def rfq12():
+        return (
+            (rfq2(), rfq2(), rfq2()),
+            (rfq2(), rfq2(), rfq2()),
+        )
+
+    for _ in range(10):
+        a, b, c = rfq12(), rfq12(), rfq12()
+        assert F.fq12_mul(F.fq12_mul(a, b), c) == F.fq12_mul(a, F.fq12_mul(b, c))
+        assert F.fq12_mul(a, F.fq12_inv(a)) == F.FQ12_ONE
+        assert F.fq12_sq(a) == F.fq12_mul(a, a)

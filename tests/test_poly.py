@@ -1,0 +1,133 @@
+"""Reference NTT tests, incl. the 4-step r x c decomposition spec.
+
+The 4-step checks mirror the reference's own spec test
+(/root/reference/src/playground.rs:82-103): a size-N FFT computed as
+column FFTs + twiddle + row FFTs over an r x c matrix must equal the
+direct FFT for all of {fwd, inv} x {plain, coset}.
+"""
+
+import random
+
+from distributed_plonk_tpu import poly as P
+from distributed_plonk_tpu.constants import R_MOD, FR_GENERATOR
+from distributed_plonk_tpu.fields import fr_inv
+
+rng = random.Random(0x4477)
+
+
+def naive_dft(domain, coeffs):
+    n = domain.size
+    w = domain.group_gen
+    out = []
+    for i in range(n):
+        acc = 0
+        for j, c in enumerate(coeffs):
+            acc = (acc + c * pow(w, i * j, R_MOD)) % R_MOD
+        out.append(acc)
+    return out
+
+
+def test_fft_matches_naive():
+    d = P.Domain(16)
+    coeffs = [rng.randrange(R_MOD) for _ in range(16)]
+    assert P.fft(d, coeffs) == naive_dft(d, coeffs)
+
+
+def test_fft_ifft_roundtrip():
+    d = P.Domain(64)
+    coeffs = [rng.randrange(R_MOD) for _ in range(64)]
+    assert P.ifft(d, P.fft(d, coeffs)) == coeffs
+    assert P.coset_ifft(d, P.coset_fft(d, coeffs)) == coeffs
+
+
+def test_coset_fft_is_shifted_eval():
+    d = P.Domain(8)
+    coeffs = [rng.randrange(R_MOD) for _ in range(8)]
+    evals = P.coset_fft(d, coeffs)
+    g = FR_GENERATOR
+    for i, e in enumerate(evals):
+        x = g * pow(d.group_gen, i, R_MOD) % R_MOD
+        assert e == P.poly_eval(coeffs, x)
+
+
+def _transpose(m):
+    return [list(row) for row in zip(*m)]
+
+
+def four_step_fft(domain, coeffs, is_inv, is_coset):
+    """The r x c decomposition the distributed NTT implements.
+
+    Stage 1 (per matrix row i of the transposed layout): optional coset
+    pre-scale by g^(i + j*r), c-point (i)FFT, twiddle by w^(+-i*j).
+    Stage 2 (per column): r-point (i)FFT, optional inverse-coset post-scale
+    by g^-(i + j*c). Matches /root/reference/src/worker.rs:66-115.
+    """
+    n = domain.size
+    r = 1 << (domain.log_size >> 1)
+    c = n // r
+    c_dom = P.Domain(c)
+    r_dom = P.Domain(r)
+    g = FR_GENERATOR
+    g_inv = fr_inv(g)
+    omega = domain.group_gen_inv if is_inv else domain.group_gen
+
+    v = list(coeffs) + [0] * (n - len(coeffs))
+    # view as c-major: t[i][j] = v[j*r + i], i in [0,r), j in [0,c)
+    mat = _transpose([v[k * r:(k + 1) * r] for k in range(c)])
+    # stage 1: row i holds c entries
+    for i in range(r):
+        row = mat[i]
+        if is_coset and not is_inv:
+            row = [u * pow(g, i + j * r, R_MOD) % R_MOD for j, u in enumerate(row)]
+        row = P.ifft(c_dom, row) if is_inv else P.fft(c_dom, row)
+        row = [u * pow(omega, i * j, R_MOD) % R_MOD for j, u in enumerate(row)]
+        mat[i] = row
+    # all-to-all transpose
+    cols = _transpose(mat)
+    # stage 2: column j holds r entries
+    for i in range(c):
+        col = cols[i]
+        col = P.ifft(r_dom, col) if is_inv else P.fft(r_dom, col)
+        if is_coset and is_inv:
+            col = [u * pow(g_inv, i + j * c, R_MOD) % R_MOD for j, u in enumerate(col)]
+        cols[i] = col
+    return [x for row in _transpose(cols) for x in row]
+
+
+def test_four_step_equals_direct_all_modes():
+    for n in (64, 128):
+        d = P.Domain(n)
+        coeffs = [rng.randrange(R_MOD) for _ in range(n)]
+        for is_inv in (False, True):
+            for is_coset in (False, True):
+                if is_coset and not is_inv:
+                    expect = P.coset_fft(d, coeffs)
+                elif is_coset and is_inv:
+                    expect = P.coset_ifft(d, coeffs)
+                elif is_inv:
+                    expect = P.ifft(d, coeffs)
+                else:
+                    expect = P.fft(d, coeffs)
+                got = four_step_fft(d, coeffs, is_inv, is_coset)
+                assert got == expect, (n, is_inv, is_coset)
+
+
+def test_synthetic_division():
+    coeffs = [rng.randrange(R_MOD) for _ in range(33)]
+    z = rng.randrange(R_MOD)
+    q = P.synthetic_divide(coeffs, z)
+    # p(X) - p(z) == q(X) * (X - z)
+    pz = P.poly_eval(coeffs, z)
+    # direct check: evaluate both sides at random points
+    for _ in range(5):
+        x = rng.randrange(R_MOD)
+        lhs = (P.poly_eval(coeffs, x) - pz) % R_MOD
+        rhs = P.poly_eval(q, x) * ((x - z) % R_MOD) % R_MOD
+        assert lhs == rhs
+
+
+def test_poly_mul_vanishing():
+    a = [rng.randrange(R_MOD) for _ in range(5)]
+    out = P.poly_mul_vanishing(a, 8)
+    x = rng.randrange(R_MOD)
+    assert P.poly_eval(out, x) == P.poly_eval(a, x) * ((pow(x, 8, R_MOD) - 1) % R_MOD) % R_MOD

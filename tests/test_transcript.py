@@ -1,0 +1,78 @@
+"""Transcript stack tests: keccak vs hashlib SHA3, STROBE/merlin behavior."""
+
+import hashlib
+
+from distributed_plonk_tpu import transcript as T
+
+
+def _sha3_256(data):
+    """SHA3-256 built on our keccak_f1600 (rate 136, pad 0x06 / 0x80)."""
+    rate = 136
+    state = bytearray(200)
+    padded = bytearray(data)
+    pad_len = rate - (len(data) % rate)
+    padded += bytes(pad_len)
+    padded[len(data)] ^= 0x06
+    padded[-1] ^= 0x80
+    for off in range(0, len(padded), rate):
+        for i in range(rate):
+            state[i] ^= padded[off + i]
+        state = T.keccak_f1600_bytes(state)
+    return bytes(state[:32])
+
+
+def test_keccak_matches_hashlib():
+    for msg in [b"", b"abc", b"x" * 135, b"y" * 136, b"z" * 1000]:
+        assert _sha3_256(msg) == hashlib.sha3_256(msg).digest(), msg[:8]
+
+
+def test_merlin_deterministic_and_order_sensitive():
+    t1 = T.MerlinTranscript(b"test")
+    t1.append_message(b"a", b"hello")
+    c1 = t1.challenge_bytes(b"c", 32)
+
+    t2 = T.MerlinTranscript(b"test")
+    t2.append_message(b"a", b"hello")
+    c2 = t2.challenge_bytes(b"c", 32)
+    assert c1 == c2
+
+    t3 = T.MerlinTranscript(b"test")
+    t3.append_message(b"a", b"hellp")
+    assert t3.challenge_bytes(b"c", 32) != c1
+
+    t4 = T.MerlinTranscript(b"test2")
+    t4.append_message(b"a", b"hello")
+    assert t4.challenge_bytes(b"c", 32) != c1
+
+
+def test_challenge_changes_after_append():
+    t = T.MerlinTranscript(b"test")
+    a = t.challenge_bytes(b"c", 64)
+    t.append_message(b"m", b"data")
+    b = t.challenge_bytes(b"c", 64)
+    assert a != b
+
+
+def test_long_absorb_crosses_rate_boundary():
+    t = T.MerlinTranscript(b"test")
+    t.append_message(b"big", b"q" * 1000)
+    assert len(t.challenge_bytes(b"c", 200)) == 200
+
+
+def test_g1_compression_flags():
+    from distributed_plonk_tpu import curve as C
+    from distributed_plonk_tpu.constants import Q_MOD
+
+    b = T.g1_to_bytes_compressed(None)
+    assert b[47] & (1 << 6)
+    p = C.G1_GEN
+    b = T.g1_to_bytes_compressed(p)
+    assert int.from_bytes(b[:47] + bytes([b[47] & 0x3F]), "little") == p[0]
+    neg = C.g1_neg(p)
+    bn = T.g1_to_bytes_compressed(neg)
+    assert (b[47] ^ bn[47]) & (1 << 7)  # exactly one of y/-y has the flag
+
+
+def test_fr_serialization_roundtrip():
+    x = 0x1234567890ABCDEF
+    assert T.fr_from_le_bytes_mod_order(T.fr_to_bytes(x)) == x
