@@ -1,0 +1,31 @@
+"""Host (pure-Python) compute backend: the CPU oracle the device backends
+are measured against — the analog of the reference's v1 local prover path
+(/root/reference/src/dispatcher.rs:523-960, its "CPU oracle")."""
+
+from .. import poly as P
+from .. import curve as C
+
+
+class PythonBackend:
+    """Reference backend. All ops on host, Python ints."""
+
+    name = "python"
+
+    def fft(self, domain, values):
+        return P.fft(domain, values)
+
+    def ifft(self, domain, values):
+        return P.ifft(domain, values)
+
+    def coset_fft(self, domain, values):
+        return P.coset_fft(domain, values)
+
+    def coset_ifft(self, domain, values):
+        return P.coset_ifft(domain, values)
+
+    def msm(self, bases, scalars):
+        """Variable-base MSM; scalars zero-padded to |bases| by caller."""
+        return C.g1_msm(bases[:len(scalars)], scalars)
+
+    def commit(self, ck, coeffs):
+        return self.msm(ck, coeffs)

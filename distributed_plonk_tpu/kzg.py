@@ -1,0 +1,110 @@
+"""KZG polynomial commitments: setup, preprocess, proving/verifying keys.
+
+Re-provides the jf-plonk surface consumed by the reference:
+`universal_setup` / `preprocess` (/root/reference/src/dispatcher2.rs:1279-1280)
+and the commit-key layout the dispatcher pads to a multiple of 32
+(/root/reference/src/dispatcher2.rs:207-208).
+"""
+
+import random
+
+from .constants import R_MOD
+from . import curve as C
+from . import poly as P
+from .circuit import NUM_WIRE_TYPES, NUM_SELECTORS
+
+
+class UniversalSrs:
+    def __init__(self, powers_of_g1, g2, tau_g2):
+        self.powers_of_g1 = powers_of_g1  # [G1, tau G1, tau^2 G1, ...]
+        self.g2 = g2
+        self.tau_g2 = tau_g2
+
+
+class VerifyingKey:
+    def __init__(self, domain_size, num_inputs, selector_comms, sigma_comms,
+                 k, g1, g2, tau_g2):
+        self.domain_size = domain_size
+        self.num_inputs = num_inputs
+        self.selector_comms = selector_comms
+        self.sigma_comms = sigma_comms
+        self.k = k
+        self.g1 = g1
+        self.g2 = g2
+        self.tau_g2 = tau_g2
+
+
+class ProvingKey:
+    def __init__(self, ck, selectors, sigmas, vk, domain):
+        self.ck = ck                # commit key: G1 powers, padded
+        self.selectors = selectors  # 13 coefficient vectors
+        self.sigmas = sigmas        # 5 coefficient vectors
+        self.vk = vk
+        self.domain = domain
+
+    @property
+    def domain_size(self):
+        return self.domain.size
+
+
+def universal_setup(max_degree, rng=None, tau=None):
+    """Simulated trusted setup (test SRS; tau is toxic waste).
+
+    Mirrors PlonkKzgSnark::universal_setup (reference src/dispatcher2.rs:1279).
+    """
+    if tau is None:
+        rng = rng or random.Random()
+        tau = rng.randrange(1, R_MOD)
+    powers = []
+    acc = 1
+    for _ in range(max_degree + 1):
+        powers.append(acc)
+        acc = acc * tau % R_MOD
+    # batch the scalar muls through one Pippenger-style pass per power is
+    # overkill here; direct double-and-add per power (host oracle only).
+    powers_of_g1 = [C.g1_mul(C.G1_GEN, p) for p in powers]
+    tau_g2 = C.g2_mul(C.G2_GEN, tau)
+    return UniversalSrs(powers_of_g1, C.G2_GEN, tau_g2)
+
+
+def commit_host(ck, coeffs):
+    """Host-side commitment (oracle); device path uses backend MSM."""
+    assert len(coeffs) <= len(ck)
+    return C.g1_msm(ck[:len(coeffs)], coeffs)
+
+
+def preprocess(srs, circuit):
+    """Build (pk, vk) for a finalized circuit.
+
+    Mirrors PlonkKzgSnark::preprocess (reference src/dispatcher2.rs:1280):
+    selector/sigma polynomials are iFFTs of their domain evaluations;
+    their commitments go into the vk (and the Fiat-Shamir transcript).
+    """
+    n = circuit.n
+    domain = circuit.eval_domain
+    srs_size = n + 3  # degree n+2 polys (blinded z) must be committable
+    assert len(srs.powers_of_g1) >= srs_size, "SRS too small for this circuit"
+    ck = list(srs.powers_of_g1[:srs_size])
+    # pad ck to a multiple of 32 with the identity, as the dispatcher does
+    # (src/dispatcher2.rs:207-208), so MSM shard sizes divide evenly.
+    while len(ck) % 32 != 0:
+        ck.append(None)
+
+    selectors = [P.ifft(domain, col) for col in circuit.selectors]
+    sigmas = [P.ifft(domain, col) for col in circuit.sigma_values()]
+
+    selector_comms = [commit_host(ck, s) for s in selectors]
+    sigma_comms = [commit_host(ck, s) for s in sigmas]
+
+    vk = VerifyingKey(
+        domain_size=n,
+        num_inputs=circuit.num_inputs,
+        selector_comms=selector_comms,
+        sigma_comms=sigma_comms,
+        k=list(circuit.k),
+        g1=C.G1_GEN,
+        g2=srs.g2,
+        tau_g2=srs.tau_g2,
+    )
+    assert len(selectors) == NUM_SELECTORS and len(sigmas) == NUM_WIRE_TYPES
+    return ProvingKey(ck, selectors, sigmas, vk, domain), vk
