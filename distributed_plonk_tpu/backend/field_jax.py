@@ -1,0 +1,205 @@
+"""Vectorized prime-field arithmetic for TPU: 16-bit limbs in uint32 lanes.
+
+This is the device replacement for the reference's `ark-ff` field layer
+(/root/reference/Cargo.toml:31-37). TPU integer units have no 64-bit multiply,
+so elements are radix-2^16 little-endian limb vectors on the LEADING axis
+(shape (L, *batch), see limbs.py): a 16x16-bit limb product fits a uint32
+exactly, and column sums of <= 2*L such products stay under 2^23 < 2^32, so
+schoolbook products accumulate carry-free before one exact carry sweep.
+
+Multiplication is Montgomery (SOS variant: full product, one low half-product
+by -p^-1 mod R, one full product by p, one shift) with R = 2^256 (Fr) /
+2^384 (Fq) — the same Montgomery radix arkworks uses, so Montgomery-form
+values are bit-compatible with the reference's in-memory representation.
+
+All functions are shape-polymorphic over the batch dims and jit-safe (static
+limb counts, no data-dependent control flow).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..constants import (
+    LIMB_BITS,
+    LIMB_MASK,
+    FR_LIMBS,
+    FQ_LIMBS,
+    R_MOD,
+    Q_MOD,
+    FR_MONT_R2,
+    FR_MONT_INV,
+    FQ_MONT_R2,
+    FQ_MONT_INV,
+)
+from .limbs import int_to_limbs
+
+
+class FieldSpec:
+    """Static per-field constants (host numpy; embedded into jit traces)."""
+
+    def __init__(self, name, mod, n_limbs, mont_r2, mont_inv):
+        self.name = name
+        self.mod = mod
+        self.n_limbs = n_limbs
+        self.mod_limbs = int_to_limbs(mod, n_limbs)
+        self.r2_limbs = int_to_limbs(mont_r2, n_limbs)
+        # full-width -p^-1 mod 2^(16L) for the SOS reduction low half-product
+        self.ninv_limbs = int_to_limbs(mont_inv, n_limbs)
+        self.one_limbs = int_to_limbs(1, n_limbs)
+
+
+FR = FieldSpec("Fr", R_MOD, FR_LIMBS, FR_MONT_R2, FR_MONT_INV)
+FQ = FieldSpec("Fq", Q_MOD, FQ_LIMBS, FQ_MONT_R2, FQ_MONT_INV)
+
+
+def _bcast_const(limbs, ndim):
+    """(L,) host constant -> (L, 1, ..., 1) for broadcasting against batch."""
+    return jnp.asarray(limbs).reshape(limbs.shape + (1,) * (ndim - 1))
+
+
+def _carry_sweep(cols):
+    """Exact carry propagation. cols: (K, *batch) uint32 with entries < 2^23.
+
+    Returns (limbs, carry_out): limbs (K, *batch) all < 2^16, carry_out the
+    overflow past the top limb (zero whenever the caller's bound guarantees
+    the value fits in K limbs).
+    """
+    k = cols.shape[0]
+    outs = []
+    carry = jnp.zeros_like(cols[0])
+    for i in range(k):
+        v = cols[i] + carry
+        outs.append(v & LIMB_MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(outs, axis=0), carry
+
+
+def _mul_columns(a, b, out_limbs):
+    """Carry-free column sums of the product, truncated to out_limbs limbs."""
+    la = a.shape[0]
+    lb = b.shape[0]
+    cols = jnp.zeros((out_limbs,) + a.shape[1:], dtype=jnp.uint32)
+    for i in range(min(la, out_limbs)):
+        width = min(lb, out_limbs - i)
+        p = a[i] * b[:width]  # (width, *batch), each product < 2^32
+        lo = p & LIMB_MASK
+        hi = p >> LIMB_BITS
+        cols = cols.at[i:i + width].add(lo)
+        hi_width = min(lb, out_limbs - i - 1)
+        if hi_width > 0:
+            cols = cols.at[i + 1:i + 1 + hi_width].add(hi[:hi_width])
+    return cols
+
+
+def _mul_full(a, b):
+    """Exact product: (La, *b) x (Lb, *b) -> (La+Lb, *b) carried limbs."""
+    cols = _mul_columns(a, b, a.shape[0] + b.shape[0])
+    limbs, carry = _carry_sweep(cols)
+    del carry  # exact product fits in La+Lb limbs
+    return limbs
+
+
+def _mul_low(a, b, out_limbs):
+    """Product mod 2^(16*out_limbs), carried limbs."""
+    cols = _mul_columns(a, b, out_limbs)
+    limbs, _ = _carry_sweep(cols)
+    return limbs
+
+
+def _add_limbs(a, b):
+    """Limbwise add with carry sweep; final carry returned separately."""
+    n = max(a.shape[0], b.shape[0])
+    outs = []
+    carry = jnp.zeros_like(a[0])
+    for i in range(n):
+        v = carry
+        if i < a.shape[0]:
+            v = v + a[i]
+        if i < b.shape[0]:
+            v = v + b[i]
+        outs.append(v & LIMB_MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(outs, axis=0), carry
+
+
+def _sub_limbs(a, b):
+    """a - b mod 2^(16L) with final borrow flag (1 iff a < b)."""
+    n = a.shape[0]
+    outs = []
+    borrow = jnp.zeros_like(a[0])
+    for i in range(n):
+        bi = b[i] if i < b.shape[0] else jnp.zeros_like(a[i])
+        need = bi + borrow  # <= 2^16, fits
+        v = (a[i] - need) & LIMB_MASK
+        borrow = (a[i] < need).astype(jnp.uint32)
+        outs.append(v)
+    return jnp.stack(outs, axis=0), borrow
+
+
+def _cond_sub_mod(spec, t):
+    """t - p if t >= p else t  (t < 2p)."""
+    p = _bcast_const(spec.mod_limbs, t.ndim)
+    d, borrow = _sub_limbs(t, p)
+    keep = (borrow == 1)
+    return jnp.where(keep[None], t, d)
+
+
+def add(spec, a, b):
+    s, carry = _add_limbs(a, b)
+    del carry  # a, b < p  =>  a+b < 2p < 2^(16L)
+    return _cond_sub_mod(spec, s)
+
+
+def sub(spec, a, b):
+    d, borrow = _sub_limbs(a, b)
+    p = _bcast_const(spec.mod_limbs, a.ndim)
+    dp, _ = _add_limbs(d, p)  # wraps mod 2^(16L): restores a-b+p when a < b
+    return jnp.where((borrow == 1)[None], dp, d)
+
+
+def neg(spec, a):
+    zero = jnp.zeros_like(a)
+    return sub(spec, zero, a)
+
+
+def mont_mul(spec, a, b):
+    """Montgomery product: a*b*R^-1 mod p, inputs/outputs reduced (< p)."""
+    l = spec.n_limbs
+    t = _mul_full(a, b)  # 2L limbs, < p^2
+    ninv = _bcast_const(spec.ninv_limbs, a.ndim)
+    m = _mul_low(t[:l], ninv, l)  # m = (t mod R) * (-p^-1) mod R
+    p = _bcast_const(spec.mod_limbs, a.ndim)
+    mp = _mul_full(m, p)  # 2L limbs, < R*p
+    s, carry = _add_limbs(t, mp)  # t + m*p  ==  0 mod R,  < R*p + p^2 < R^2
+    del carry
+    return _cond_sub_mod(spec, s[l:])  # (t + m*p) / R < 2p
+
+
+def to_mont(spec, a):
+    return mont_mul(spec, a, _bcast_const(spec.r2_limbs, a.ndim) * jnp.ones_like(a[:1]))
+
+
+def from_mont(spec, a):
+    one = _bcast_const(spec.one_limbs, a.ndim) * jnp.ones_like(a[:1])
+    return mont_mul(spec, a, one)
+
+
+def mont_sq(spec, a):
+    return mont_mul(spec, a, a)
+
+
+def is_zero(spec, a):
+    return jnp.all(a == 0, axis=0)
+
+
+def eq(spec, a, b):
+    return jnp.all(a == b, axis=0)
+
+
+def select(cond, a, b):
+    """cond: (*batch,) bool; a, b: (L, *batch) -> where(cond, a, b)."""
+    return jnp.where(cond[None], a, b)
+
+
+def double(spec, a):
+    return add(spec, a, a)

@@ -1,0 +1,61 @@
+"""Host-side conversion between Python ints and device limb arrays.
+
+Device representation: radix-2^16 little-endian limbs held in uint32 lanes,
+limbs on the LEADING axis -> shape (L, *batch). Leading-axis layout keeps the
+batch dimension on the TPU vector lanes (last-dim tiling is (8, 128)), so
+elementwise field ops vectorize over the polynomial/point batch with no lane
+padding waste.
+
+This is the analog of the reference's host<->wire boundary
+(/root/reference/src/utils.rs:27-43), but with an explicit, documented layout
+instead of an unsafe transmute.
+"""
+
+import numpy as np
+
+from ..constants import LIMB_BITS, LIMB_MASK, FR_LIMBS, FQ_LIMBS
+
+assert LIMB_BITS == 16
+
+
+def int_to_limbs(x, n_limbs):
+    """One Python int -> (n_limbs,) uint32 array of 16-bit limbs."""
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n_limbs)],
+                    dtype=np.uint32)
+
+
+def ints_to_limbs(xs, n_limbs):
+    """List of ints -> (n_limbs, len(xs)) uint32 array (leading-axis limbs)."""
+    nbytes = n_limbs * 2
+    buf = b"".join(int(x).to_bytes(nbytes, "little") for x in xs)
+    arr = np.frombuffer(buf, dtype="<u2").reshape(len(xs), n_limbs)
+    return np.ascontiguousarray(arr.T).astype(np.uint32)
+
+
+def limbs_to_int(limbs):
+    """(n_limbs,) array -> Python int."""
+    x = 0
+    for i, limb in enumerate(np.asarray(limbs, dtype=np.uint64)):
+        x |= int(limb) << (LIMB_BITS * i)
+    return x
+
+
+def limbs_to_ints(limbs):
+    """(n_limbs, n) array -> list of n Python ints."""
+    arr = np.asarray(limbs)
+    assert arr.ndim == 2
+    # a silent >2^16 limb here would mask a missing carry sweep in a kernel
+    assert (arr <= LIMB_MASK).all(), "unreduced limb at oracle boundary"
+    a16 = arr.T.astype("<u2")  # (n, n_limbs)
+    raw = a16.tobytes()
+    nbytes = arr.shape[0] * 2
+    return [int.from_bytes(raw[i * nbytes:(i + 1) * nbytes], "little")
+            for i in range(arr.shape[1])]
+
+
+def fr_to_limbs(xs):
+    return ints_to_limbs(xs, FR_LIMBS)
+
+
+def fq_to_limbs(xs):
+    return ints_to_limbs(xs, FQ_LIMBS)

@@ -20,7 +20,6 @@ from .fields import fr_inv, batch_inverse
 from . import curve as C
 from . import poly as P
 from .circuit import (
-    GATE_WIDTH,
     NUM_WIRE_TYPES,
     Q_LC,
     Q_MUL,
@@ -91,6 +90,12 @@ def verify(vk, pub_input, proof, domain=None, rng=None):
 
     if not _validate_proof_shape(proof):
         return False
+    # Reject length mismatches: extra "public inputs" would land on non-IO
+    # rows via L_i(zeta) and let a prover bind arbitrary claimed values.
+    if len(pub_input) != vk.num_inputs:
+        return False
+    if not all(isinstance(x, int) and 0 <= x < R_MOD for x in pub_input):
+        return False
 
     beta, gamma, alpha, zeta, vch = _replay_challenges(vk, pub_input, proof)
 
@@ -98,10 +103,10 @@ def verify(vk, pub_input, proof, domain=None, rng=None):
     if vanish_eval == 0:
         return False  # zeta landed in the domain; reject (prob ~ n/r)
     zeta_minus_1_inv = fr_inv((zeta - 1) % R_MOD)
-    lagrange_1_eval = vanish_eval * fr_inv(n % R_MOD) % R_MOD * zeta_minus_1_inv % R_MOD
+    n_inv = fr_inv(n % R_MOD)
+    lagrange_1_eval = vanish_eval * n_inv % R_MOD * zeta_minus_1_inv % R_MOD
 
     # PI(zeta) = sum_i pub_i * L_i(zeta), L_i(zeta) = w^i/n * Z_H(zeta)/(zeta-w^i)
-    n_inv = fr_inv(n % R_MOD)
     w_pows = []
     w_pow = 1
     for _ in pub_input:
