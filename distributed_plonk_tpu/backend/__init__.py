@@ -1,0 +1,6 @@
+"""Device backends (JAX limb kernels) + the pure-Python host oracle.
+
+Kept import-free: python_backend must work without jax. The JAX persistent
+compilation cache is configured in field_jax.py, the root of every device
+module's import chain.
+"""

@@ -16,8 +16,25 @@ All functions are shape-polymorphic over the batch dims and jit-safe (static
 limb counts, no data-dependent control flow).
 """
 
+import os
+
 import numpy as np
+import jax
 import jax.numpy as jnp
+
+# Persistent compilation cache: limb-arithmetic graphs are large (O(log n)
+# fused stages, ~1k ops each) and compile time dominates cold-start
+# wall-clock. Defer to the standard JAX env knob when the user set it.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    _default_cache = os.environ.get(
+        "DPT_JAX_CACHE_DIR",
+        os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", _default_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - older jax without these options
+        pass
 
 from ..constants import (
     LIMB_BITS,

@@ -1,0 +1,138 @@
+"""Single-device radix-2 NTT/iNTT (+ coset variants) over Fr limb arrays.
+
+Device replacement for `ark-poly`'s Radix2EvaluationDomain as the reference
+workers use it (/root/reference/src/worker.rs:82-115): forward/inverse NTT
+with optional coset pre/post scaling by the Fr multiplicative generator g=7.
+Semantics are bit-identical to the host oracle in poly.py.
+
+Design notes (TPU-first):
+- One vectorized butterfly per stage: the whole stage is a single reshaped
+  (16, blocks, 2, half) Montgomery multiply + add/sub, so the traced op
+  count is O(log n), independent of n, and XLA sees large fusible
+  elementwise ops that map onto the VPU.
+- Twiddles are precomputed incremental tables in Montgomery form (the
+  reference recomputes g.pow per element on the hot path,
+  src/worker.rs:77-79,91-93 — a known inefficiency we do not copy).
+- The iNTT 1/n scale and the inverse-coset g^-i scale are fused into one
+  table multiply.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..constants import R_MOD, FR_GENERATOR, FR_LIMBS, FR_MONT_R
+from ..fields import fr_inv, fr_root_of_unity
+from . import field_jax as FJ
+from .field_jax import FR
+from .limbs import ints_to_limbs, limbs_to_ints
+
+
+def _mont_table(xs):
+    """Host ints -> (16, len) Montgomery-form limb table."""
+    return ints_to_limbs([x * FR_MONT_R % R_MOD for x in xs], FR_LIMBS)
+
+
+def _powers(base, count, start=1):
+    out = [start % R_MOD]
+    for _ in range(count - 1):
+        out.append(out[-1] * base % R_MOD)
+    return out
+
+
+class NttPlan:
+    """Precomputed tables + cached jitted kernels for one domain size."""
+
+    def __init__(self, n):
+        assert n >= 1 and n & (n - 1) == 0
+        self.n = n
+        self.log_n = n.bit_length() - 1
+        w = fr_root_of_unity(n)
+        w_inv = fr_inv(w) if n > 1 else 1
+
+        idx = np.arange(n, dtype=np.int64)
+        rev = np.zeros(n, dtype=np.int64)
+        for s in range(self.log_n):
+            rev |= ((idx >> s) & 1) << (self.log_n - 1 - s)
+        self.perm = rev.astype(np.int32)
+
+        self.tw_fwd = []
+        self.tw_inv = []
+        m = 1
+        while m < n:
+            wm = pow(w, n // (2 * m), R_MOD)
+            wmi = pow(w_inv, n // (2 * m), R_MOD)
+            self.tw_fwd.append(_mont_table(_powers(wm, m)))
+            self.tw_inv.append(_mont_table(_powers(wmi, m)))
+            m <<= 1
+
+        g = FR_GENERATOR
+        n_inv = fr_inv(n % R_MOD)
+        self.coset_tab = _mont_table(_powers(g, n))
+        # fused iNTT scale: n^-1 * g^-i (coset) / n^-1 (plain)
+        self.inv_coset_tab = _mont_table(_powers(fr_inv(g), n, start=n_inv))
+        self.n_inv_tab = _mont_table([n_inv])
+        self._fns = {}
+
+    # --- core (Montgomery-form in/out) ---------------------------------------
+
+    def _core(self, v, inverse, coset):
+        n = self.n
+        if n == 1:
+            return v
+        if coset and not inverse:
+            v = FJ.mont_mul(FR, v, jnp.asarray(self.coset_tab))
+        v = v[:, self.perm]
+        tables = self.tw_inv if inverse else self.tw_fwd
+        for tw in tables:
+            m = tw.shape[1]
+            blocks = n // (2 * m)
+            v = v.reshape(FR_LIMBS, blocks, 2, m)
+            u = v[:, :, 0, :]
+            t = v[:, :, 1, :]
+            twb = jnp.broadcast_to(jnp.asarray(tw)[:, None, :], t.shape)
+            t = FJ.mont_mul(FR, t, twb)
+            v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=2)
+            v = v.reshape(FR_LIMBS, n)
+        if inverse:
+            if coset:
+                tab = jnp.asarray(self.inv_coset_tab)
+            else:  # symbolic broadcast: only the 16-limb constant is embedded
+                tab = jnp.broadcast_to(jnp.asarray(self.n_inv_tab), (FR_LIMBS, n))
+            v = FJ.mont_mul(FR, v, tab)
+        return v
+
+    def kernel(self, inverse=False, coset=False, boundary="mont"):
+        """Jitted (16, n) -> (16, n) kernel.
+
+        boundary="mont": input/output in Montgomery form (device-resident
+        pipelines). boundary="plain": canonical-form input/output (host
+        round-trips); conversion is fused into the same XLA program.
+        """
+        key = (inverse, coset, boundary)
+        if key not in self._fns:
+            if boundary == "mont":
+                fn = lambda v: self._core(v, inverse, coset)
+            else:
+                fn = lambda v: FJ.from_mont(
+                    FR, self._core(FJ.to_mont(FR, v), inverse, coset))
+            self._fns[key] = jax.jit(fn)
+        return self._fns[key]
+
+    # --- host-boundary convenience (int lists, zero-padded to n) -------------
+
+    def run_ints(self, values, inverse=False, coset=False):
+        assert len(values) <= self.n
+        padded = list(values) + [0] * (self.n - len(values))
+        v = jnp.asarray(ints_to_limbs(padded, FR_LIMBS))
+        out = self.kernel(inverse, coset, boundary="plain")(v)
+        return limbs_to_ints(np.asarray(out))
+
+
+_PLANS = {}
+
+
+def get_plan(n):
+    if n not in _PLANS:
+        _PLANS[n] = NttPlan(n)
+    return _PLANS[n]

@@ -11,6 +11,8 @@ import os
 # (JAX_PLATFORMS=axon): per-op tunnel latency makes eager tests unusable, and
 # the sharding tests need the 8-device virtual mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The persistent compilation cache itself is configured by
+# distributed_plonk_tpu.backend.field_jax at import time.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,54 @@
+"""Device NTT kernels vs the poly.py oracle — all 8 flag combos.
+
+Mirrors the reference's FFT integration matrix ({main,quot} x {fwd,inv} x
+{coset,plain}, /root/reference/src/dispatcher.rs:273-345) on two domain
+sizes, with the oracle being the pure-Python radix-2 NTT.
+"""
+
+import random
+
+import pytest
+
+from distributed_plonk_tpu import poly as P
+from distributed_plonk_tpu.constants import R_MOD
+from distributed_plonk_tpu.backend.ntt_jax import get_plan
+
+RNG = random.Random(0x7717)
+
+
+def _oracle(domain, values, inverse, coset):
+    if inverse and coset:
+        return P.coset_ifft(domain, values)
+    if inverse:
+        return P.ifft(domain, values)
+    if coset:
+        return P.coset_fft(domain, values)
+    return P.fft(domain, values)
+
+
+@pytest.mark.parametrize("n", [32, 128])
+@pytest.mark.parametrize("inverse", [False, True])
+@pytest.mark.parametrize("coset", [False, True])
+def test_ntt_matches_oracle(n, inverse, coset):
+    domain = P.Domain(n)
+    plan = get_plan(n)
+    values = [RNG.randrange(R_MOD) for _ in range(n)]
+    got = plan.run_ints(values, inverse=inverse, coset=coset)
+    assert got == _oracle(domain, values, inverse, coset)
+
+
+def test_ntt_short_input_padding():
+    n = 64
+    domain = P.Domain(n)
+    plan = get_plan(n)
+    values = [RNG.randrange(R_MOD) for _ in range(20)]
+    assert plan.run_ints(values) == P.fft(domain, values)
+
+
+def test_fft_ifft_roundtrip_device():
+    n = 64
+    plan = get_plan(n)
+    values = [RNG.randrange(R_MOD) for _ in range(n)]
+    assert plan.run_ints(plan.run_ints(values), inverse=True) == values
+    assert plan.run_ints(plan.run_ints(values, coset=True),
+                         inverse=True, coset=True) == values
