@@ -1,0 +1,139 @@
+"""Vectorized BLS12-381 G1 arithmetic on device (Jacobian over limb-Fq).
+
+Device replacement for `ark-ec`'s G1 group ops as used by the reference's
+MSM workers (/root/reference/src/worker.rs:122). Points are (X, Y, Z)
+tuples of (24, *batch) uint32 Montgomery limb arrays; Z == 0 encodes the
+point at infinity (matching the oracle's (1, 1, 0) convention, curve.py).
+
+All control flow is branch-free: the add kernel computes the generic sum,
+the doubling, and infinity fallbacks unconditionally and `where`-selects —
+the TPU-idiomatic shape for data-dependent curve edge cases.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..constants import FQ_MONT_R, FQ_LIMBS, Q_MOD
+from . import field_jax as FJ
+from .field_jax import FQ
+from .limbs import int_to_limbs, ints_to_limbs, limbs_to_ints
+
+_MONT_ONE = int_to_limbs(FQ_MONT_R, FQ_LIMBS)  # 1 in Montgomery form
+_MONT_R_INV = pow(FQ_MONT_R, Q_MOD - 2, Q_MOD)
+
+
+def _mont_one_like(x):
+    return jnp.broadcast_to(
+        jnp.asarray(_MONT_ONE).reshape((FQ_LIMBS,) + (1,) * (x.ndim - 1)), x.shape)
+
+
+def pt_inf(batch_shape=()):
+    """Infinity: (1, 1, 0) in Montgomery form."""
+    shape = (FQ_LIMBS,) + tuple(batch_shape)
+    one = jnp.broadcast_to(
+        jnp.asarray(_MONT_ONE).reshape((FQ_LIMBS,) + (1,) * len(batch_shape)), shape)
+    return (one, one, jnp.zeros(shape, dtype=jnp.uint32))
+
+
+def pt_select(cond, p, q):
+    """cond (*batch,) ? p : q, componentwise."""
+    return tuple(FJ.select(cond, a, b) for a, b in zip(p, q))
+
+
+def pt_is_inf(p):
+    return FJ.is_zero(FQ, p[2])
+
+
+def pt_neg(p):
+    return (p[0], FJ.neg(FQ, p[1]), p[2])
+
+
+def from_affine(x, y, inf_mask):
+    """(24, *b) coords in Montgomery form + bool inf mask -> Jacobian."""
+    one = _mont_one_like(x)
+    z = jnp.where(inf_mask[None], jnp.zeros_like(x), one)
+    return (x, y, z)
+
+
+def _dbl(spec, a):
+    return FJ.add(spec, a, a)
+
+
+def jac_double(p):
+    """dbl-2009-l (a=0), identical formula to the oracle
+    (curve.py _g1_jac_double_nonzero); Z1=0 propagates to Z3=0."""
+    x1, y1, z1 = p
+    a = FJ.mont_mul(FQ, x1, x1)
+    b = FJ.mont_mul(FQ, y1, y1)
+    c = FJ.mont_mul(FQ, b, b)
+    t = FJ.add(FQ, x1, b)
+    t = FJ.mont_mul(FQ, t, t)
+    d = _dbl(FQ, FJ.sub(FQ, FJ.sub(FQ, t, a), c))
+    e = FJ.add(FQ, _dbl(FQ, a), a)
+    f = FJ.mont_mul(FQ, e, e)
+    x3 = FJ.sub(FQ, f, _dbl(FQ, d))
+    c8 = _dbl(FQ, _dbl(FQ, _dbl(FQ, c)))
+    y3 = FJ.sub(FQ, FJ.mont_mul(FQ, e, FJ.sub(FQ, d, x3)), c8)
+    z3 = _dbl(FQ, FJ.mont_mul(FQ, y1, z1))
+    return (x3, y3, z3)
+
+
+def jac_add(p, q):
+    """add-2007-bl with branch-free edge handling (P==Q -> double,
+    P==-Q -> infinity, either infinite -> other operand)."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    z1z1 = FJ.mont_mul(FQ, z1, z1)
+    z2z2 = FJ.mont_mul(FQ, z2, z2)
+    u1 = FJ.mont_mul(FQ, x1, z2z2)
+    u2 = FJ.mont_mul(FQ, x2, z1z1)
+    s1 = FJ.mont_mul(FQ, FJ.mont_mul(FQ, y1, z2), z2z2)
+    s2 = FJ.mont_mul(FQ, FJ.mont_mul(FQ, y2, z1), z1z1)
+    h = FJ.sub(FQ, u2, u1)
+    h2 = _dbl(FQ, h)
+    i = FJ.mont_mul(FQ, h2, h2)
+    j = FJ.mont_mul(FQ, h, i)
+    rr = _dbl(FQ, FJ.sub(FQ, s2, s1))
+    v = FJ.mont_mul(FQ, u1, i)
+    x3 = FJ.sub(FQ, FJ.sub(FQ, FJ.mont_mul(FQ, rr, rr), j), _dbl(FQ, v))
+    y3 = FJ.sub(FQ, FJ.mont_mul(FQ, rr, FJ.sub(FQ, v, x3)),
+                _dbl(FQ, FJ.mont_mul(FQ, s1, j)))
+    zz = FJ.add(FQ, z1, z2)
+    z3 = FJ.mont_mul(FQ, FJ.sub(FQ, FJ.sub(FQ, FJ.mont_mul(FQ, zz, zz), z1z1), z2z2), h)
+    res = (x3, y3, z3)
+
+    p_inf = FJ.is_zero(FQ, z1)
+    q_inf = FJ.is_zero(FQ, z2)
+    both_fin = ~p_inf & ~q_inf
+    h_zero = FJ.eq(FQ, u1, u2) & both_fin
+    s_eq = FJ.eq(FQ, s1, s2)
+
+    res = pt_select(h_zero & s_eq, jac_double(p), res)
+    res = pt_select(h_zero & ~s_eq, pt_inf(z1.shape[1:]), res)
+    res = pt_select(q_inf, p, res)
+    res = pt_select(p_inf, q, res)
+    return res
+
+
+# --- host boundary helpers (tests / debugging; oracle-grade, not hot) --------
+
+def affine_to_device(points):
+    """list[(x, y) | None] -> Jacobian tuple of (24, n) Montgomery arrays."""
+    xs = [(p[0] * FQ_MONT_R % Q_MOD) if p else 0 for p in points]
+    ys = [(p[1] * FQ_MONT_R % Q_MOD) if p else 0 for p in points]
+    inf = np.array([p is None for p in points])
+    return from_affine(jnp.asarray(ints_to_limbs(xs, FQ_LIMBS)),
+                       jnp.asarray(ints_to_limbs(ys, FQ_LIMBS)),
+                       jnp.asarray(inf))
+
+
+def device_to_affine(p):
+    """Jacobian tuple of (24, n) Montgomery arrays -> list[(x, y) | None]."""
+    from .. import curve as C
+
+    cols = [limbs_to_ints(np.asarray(c)) for c in p]
+    out = []
+    for X, Y, Z in zip(*cols):
+        jac = tuple(v * _MONT_R_INV % Q_MOD for v in (X, Y, Z))
+        out.append(C.g1_from_jac(jac))
+    return out

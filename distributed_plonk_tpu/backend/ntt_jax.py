@@ -74,50 +74,62 @@ class NttPlan:
         self.n_inv_tab = _mont_table([n_inv])
         self._fns = {}
 
-    # --- core (Montgomery-form in/out) ---------------------------------------
-
-    def _core(self, v, inverse, coset):
-        n = self.n
-        if n == 1:
-            return v
-        if coset and not inverse:
-            v = FJ.mont_mul(FR, v, jnp.asarray(self.coset_tab))
-        v = v[:, self.perm]
-        tables = self.tw_inv if inverse else self.tw_fwd
-        for tw in tables:
-            m = tw.shape[1]
-            blocks = n // (2 * m)
-            v = v.reshape(FR_LIMBS, blocks, 2, m)
-            u = v[:, :, 0, :]
-            t = v[:, :, 1, :]
-            twb = jnp.broadcast_to(jnp.asarray(tw)[:, None, :], t.shape)
-            t = FJ.mont_mul(FR, t, twb)
-            v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=2)
-            v = v.reshape(FR_LIMBS, n)
-        if inverse:
-            if coset:
-                tab = jnp.asarray(self.inv_coset_tab)
-            else:  # symbolic broadcast: only the 16-limb constant is embedded
-                tab = jnp.broadcast_to(jnp.asarray(self.n_inv_tab), (FR_LIMBS, n))
-            v = FJ.mont_mul(FR, v, tab)
-        return v
-
     def kernel(self, inverse=False, coset=False, boundary="mont"):
         """Jitted (16, n) -> (16, n) kernel.
 
         boundary="mont": input/output in Montgomery form (device-resident
         pipelines). boundary="plain": canonical-form input/output (host
         round-trips); conversion is fused into the same XLA program.
+
+        The O(n) tables (permutation, twiddles, coset scales) are passed as
+        traced arguments, not baked-in constants, so compiled programs and
+        persistent-cache entries stay small.
         """
         key = (inverse, coset, boundary)
         if key not in self._fns:
-            if boundary == "mont":
-                fn = lambda v: self._core(v, inverse, coset)
-            else:
-                fn = lambda v: FJ.from_mont(
-                    FR, self._core(FJ.to_mont(FR, v), inverse, coset))
-            self._fns[key] = jax.jit(fn)
-        return self._fns[key]
+            n = self.n
+            plain = boundary == "plain"
+            consts = {
+                "perm": jnp.asarray(self.perm),
+                "tables": tuple(jnp.asarray(t) for t in
+                                (self.tw_inv if inverse else self.tw_fwd)),
+            }
+            if coset and not inverse:
+                consts["pre"] = jnp.asarray(self.coset_tab)
+            if inverse:
+                consts["post"] = jnp.asarray(
+                    self.inv_coset_tab if coset else self.n_inv_tab)
+
+            @jax.jit
+            def fn(v, consts):
+                if plain:
+                    v = FJ.to_mont(FR, v)
+                if "pre" in consts:
+                    v = FJ.mont_mul(FR, v, consts["pre"])
+                if n > 1:
+                    v = v[:, consts["perm"]]
+                for tw in consts["tables"]:
+                    m = tw.shape[1]
+                    blocks = n // (2 * m)
+                    v = v.reshape(FR_LIMBS, blocks, 2, m)
+                    u = v[:, :, 0, :]
+                    t = v[:, :, 1, :]
+                    twb = jnp.broadcast_to(tw[:, None, :], t.shape)
+                    t = FJ.mont_mul(FR, t, twb)
+                    v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=2)
+                    v = v.reshape(FR_LIMBS, n)
+                if "post" in consts:
+                    post = consts["post"]
+                    if post.shape[1] == 1:  # plain 1/n: broadcast symbolically
+                        post = jnp.broadcast_to(post, (FR_LIMBS, n))
+                    v = FJ.mont_mul(FR, v, post)
+                if plain:
+                    v = FJ.from_mont(FR, v)
+                return v
+
+            self._fns[key] = (fn, consts)
+        fn, consts = self._fns[key]
+        return lambda v: fn(v, consts)
 
     # --- host-boundary convenience (int lists, zero-padded to n) -------------
 

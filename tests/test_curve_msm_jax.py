@@ -1,0 +1,60 @@
+"""Device G1 kernels + MSM vs the curve.py oracle."""
+
+import random
+
+import jax
+import pytest
+
+from distributed_plonk_tpu import curve as C
+from distributed_plonk_tpu.constants import R_MOD
+from distributed_plonk_tpu.backend import curve_jax as CJ
+from distributed_plonk_tpu.backend import msm_jax
+
+RNG = random.Random(0xC0FFEE)
+
+
+def _rand_points(n):
+    return [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD)) for _ in range(n)]
+
+
+def test_jac_add_double_random():
+    n = 16
+    ps = _rand_points(n)
+    qs = _rand_points(n)
+    dev_p = CJ.affine_to_device(ps)
+    dev_q = CJ.affine_to_device(qs)
+    add_fn = jax.jit(CJ.jac_add)
+    dbl_fn = jax.jit(CJ.jac_double)
+    got_add = CJ.device_to_affine(add_fn(dev_p, dev_q))
+    got_dbl = CJ.device_to_affine(dbl_fn(dev_p))
+    assert got_add == [C.g1_add_affine(p, q) for p, q in zip(ps, qs)]
+    assert got_dbl == [C.g1_add_affine(p, p) for p in ps]
+
+
+def test_jac_add_edge_cases():
+    p = _rand_points(1)[0]
+    q = _rand_points(1)[0]
+    lhs = [p, p, p, None, None, p]
+    rhs = [p, C.g1_neg(p), None, p, None, q]
+    dev_l = CJ.affine_to_device(lhs)
+    dev_r = CJ.affine_to_device(rhs)
+    got = CJ.device_to_affine(jax.jit(CJ.jac_add)(dev_l, dev_r))
+    assert got == [C.g1_add_affine(a, b) for a, b in zip(lhs, rhs)]
+
+
+@pytest.mark.parametrize("n", [64])
+def test_msm_matches_oracle(n):
+    bases = _rand_points(n - 2) + [None, None]  # infinity padding like the SRS
+    scalars = ([RNG.randrange(R_MOD) for _ in range(n - 4)]
+               + [0, 1, R_MOD - 1, RNG.randrange(R_MOD)])
+    got = msm_jax.msm(bases, scalars)
+    assert got == C.g1_msm(bases, scalars)
+
+
+def test_msm_short_scalars_and_reuse():
+    bases = _rand_points(32)
+    ctx = msm_jax.MsmContext(bases)
+    s1 = [RNG.randrange(R_MOD) for _ in range(20)]  # shorter than bases
+    s2 = [RNG.randrange(R_MOD) for _ in range(32)]
+    assert ctx.msm(s1) == C.g1_msm(bases[:20], s1)
+    assert ctx.msm(s2) == C.g1_msm(bases, s2)
