@@ -1,0 +1,48 @@
+"""Single-device JAX backend: NTT + MSM on the TPU limb kernels.
+
+The device analog of one reference worker's compute surface
+(/root/reference/src/worker.rs:125-409): the prover's round logic stays on
+host (like the dispatcher), every FFT and MSM runs on device. Heavy state
+(SRS bases as Montgomery limb arrays, NTT plans/twiddles) is cached
+device-resident across calls, like the worker's `State`
+(/root/reference/src/worker.rs:42-59).
+"""
+
+from . import ntt_jax
+from .msm_jax import MsmContext
+
+
+class JaxBackend:
+    """Backend over single-device jitted kernels (plain int host boundary)."""
+
+    name = "jax"
+
+    def __init__(self):
+        self._msm_ctxs = {}
+
+    def fft(self, domain, values):
+        return ntt_jax.get_plan(domain.size).run_ints(values)
+
+    def ifft(self, domain, values):
+        return ntt_jax.get_plan(domain.size).run_ints(values, inverse=True)
+
+    def coset_fft(self, domain, values):
+        return ntt_jax.get_plan(domain.size).run_ints(values, coset=True)
+
+    def coset_ifft(self, domain, values):
+        return ntt_jax.get_plan(domain.size).run_ints(values, inverse=True, coset=True)
+
+    def _ctx(self, bases):
+        # keyed by identity; the bases reference is retained so the id can
+        # never be recycled by a different object while cached
+        key = id(bases)
+        if key not in self._msm_ctxs:
+            self._msm_ctxs[key] = (bases, MsmContext(bases))
+        return self._msm_ctxs[key][1]
+
+    def msm(self, bases, scalars):
+        """Variable-base MSM; scalars zero-padded to |bases| on device."""
+        return self._ctx(bases).msm(scalars)
+
+    def commit(self, ck, coeffs):
+        return self.msm(ck, coeffs)

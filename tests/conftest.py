@@ -13,6 +13,46 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 # The persistent compilation cache itself is configured by
 # distributed_plonk_tpu.backend.field_jax at import time.
+
+import pytest
+
+
+def build_test_circuit():
+    """Small circuit exercising every selector type."""
+    from distributed_plonk_tpu.circuit import PlonkCircuit
+
+    ckt = PlonkCircuit()
+    x = ckt.create_public_variable(5)
+    y = ckt.create_public_variable(11)
+    s = ckt.add(x, y)
+    p = ckt.mul(x, y)
+    ckt.power5(s)
+    l = ckt.lc([x, y, s, p], [2, 3, 5, 7])
+    d = ckt.add_constant(l, 42)
+    m = ckt.mul_constant(d, 9)
+    ckt.sub(m, p)
+    ckt.enforce_ecc_product(x, y, s, p, ckt.one_var, 5 * 11 * 16 * 55)
+    return ckt
+
+
+@pytest.fixture(scope="session")
+def proven():
+    """Finalized test circuit + keys + host-oracle proof (seed 1)."""
+    import random
+    from distributed_plonk_tpu import kzg
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+
+    ckt = build_test_circuit()
+    ok, row = ckt.check_satisfiability()
+    assert ok, f"unsatisfied at row {row}"
+    ckt.finalize()
+    ok, row = ckt.check_satisfiability()
+    assert ok, f"unsatisfied after finalize at row {row}"
+    srs = kzg.universal_setup(ckt.n + 3, tau=0xDEADBEEF)
+    pk, vk = kzg.preprocess(srs, ckt)
+    proof = prove(random.Random(1), ckt, pk, PythonBackend())
+    return ckt, pk, vk, proof
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -9,44 +9,13 @@ the reference lacks.
 
 import random
 
-import pytest
-
 from distributed_plonk_tpu.circuit import PlonkCircuit
-from distributed_plonk_tpu import kzg
 from distributed_plonk_tpu.prover import prove
 from distributed_plonk_tpu.verifier import verify
 from distributed_plonk_tpu.backend.python_backend import PythonBackend
 from distributed_plonk_tpu.constants import R_MOD
 
-
-def build_test_circuit():
-    """Small circuit exercising every selector type."""
-    ckt = PlonkCircuit()
-    x = ckt.create_public_variable(5)
-    y = ckt.create_public_variable(11)
-    s = ckt.add(x, y)
-    p = ckt.mul(x, y)
-    ckt.power5(s)
-    l = ckt.lc([x, y, s, p], [2, 3, 5, 7])
-    d = ckt.add_constant(l, 42)
-    m = ckt.mul_constant(d, 9)
-    ckt.sub(m, p)
-    ckt.enforce_ecc_product(x, y, s, p, ckt.one_var, 5 * 11 * 16 * 55)
-    return ckt
-
-
-@pytest.fixture(scope="module")
-def proven():
-    ckt = build_test_circuit()
-    ok, row = ckt.check_satisfiability()
-    assert ok, f"unsatisfied at row {row}"
-    ckt.finalize()
-    ok, row = ckt.check_satisfiability()
-    assert ok, f"unsatisfied after finalize at row {row}"
-    srs = kzg.universal_setup(ckt.n + 3, tau=0xDEADBEEF)
-    pk, vk = kzg.preprocess(srs, ckt)
-    proof = prove(random.Random(1), ckt, pk, PythonBackend())
-    return ckt, pk, vk, proof
+# the shared `proven` fixture (circuit + keys + host proof) lives in conftest.py
 
 
 def test_proof_verifies(proven):
