@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Primary metric: single-device NTT throughput (the prover's dominant kernel,
+reference hot loop /root/reference/src/worker.rs:66-115) on a 2^20 domain —
+the scale of the reference's MSM micro-test (src/dispatcher.rs:188-196).
+
+vs_baseline: speedup over the pure-Python host oracle (the stand-in for the
+reference's CPU path; the reference itself publishes no numbers — see
+BASELINE.md). The oracle's 2^20 wall-clock is measured once and cached in
+.bench_host_baseline.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+LOG_N = int(os.environ.get("DPT_BENCH_LOG_N", "20"))
+N = 1 << LOG_N
+_BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".bench_host_baseline.json")
+
+
+def host_oracle_seconds():
+    key = f"ntt_2p{LOG_N}_host_s"
+    if os.path.exists(_BASELINE_CACHE):
+        with open(_BASELINE_CACHE) as f:
+            cached = json.load(f)
+        if key in cached:
+            return cached[key]
+    else:
+        cached = {}
+    import random
+    from distributed_plonk_tpu import poly as P
+    from distributed_plonk_tpu.constants import R_MOD
+
+    rng = random.Random(1)
+    domain = P.Domain(N)
+    values = [rng.randrange(R_MOD) for _ in range(N)]
+    t0 = time.perf_counter()
+    P.fft(domain, values)
+    host_s = time.perf_counter() - t0
+    cached[key] = host_s
+    with open(_BASELINE_CACHE, "w") as f:
+        json.dump(cached, f)
+    return host_s
+
+
+def device_seconds():
+    import numpy as np
+    from distributed_plonk_tpu.backend import ntt_jax
+
+    plan = ntt_jax.get_plan(N)
+    kernel = plan.kernel()  # Montgomery boundary: the device-resident hot path
+    rng = np.random.default_rng(2)
+    v = rng.integers(0, 1 << 16, size=(16, N), dtype=np.uint32)
+    out = kernel(v)
+    out.block_until_ready()  # compile + warm
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = kernel(v)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    host_s = host_oracle_seconds()
+    dev_s = device_seconds()
+    print(json.dumps({
+        "metric": f"ntt_2p{LOG_N}_throughput",
+        "value": round(N / dev_s),
+        "unit": "field_elements_per_s",
+        "vs_baseline": round(host_s / dev_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

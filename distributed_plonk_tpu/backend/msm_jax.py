@@ -62,7 +62,11 @@ def _window_buckets(px, py, pz, digits, group):
     xs = (to_scan(px), to_scan(py), to_scan(pz),
           digits.reshape(group, steps).T)
 
-    bx, by, bz = CJ.pt_inf((group, NUM_BUCKETS))
+    # varying-zero: under shard_map the scan carry must inherit the inputs'
+    # varying-manual-axes tag; adding a data-derived 0 does exactly that
+    # (and constant-folds away otherwise)
+    vz = pz.ravel()[0] & 0
+    bx, by, bz = (b + vz for b in CJ.pt_inf((group, NUM_BUCKETS)))
 
     def step(carry, x):
         bx, by, bz = carry
@@ -79,7 +83,7 @@ def _window_buckets(px, py, pz, digits, group):
     def red(acc, grp):
         return CJ.jac_add(acc, grp), None
 
-    acc0 = CJ.pt_inf((NUM_BUCKETS,))
+    acc0 = tuple(b + vz for b in CJ.pt_inf((NUM_BUCKETS,)))
     grps = tuple(b.transpose(1, 0, 2) for b in (bx, by, bz))  # (group, 24, 256)
     acc, _ = lax.scan(red, acc0, grps)
     return acc
@@ -100,7 +104,8 @@ def _finish(bx, by, bz):
         acc = CJ.jac_add(acc, run)
         return (run, acc), None
 
-    inf_w = CJ.pt_inf((NUM_WINDOWS,))
+    vz = bz.ravel()[0] & 0  # varying-zero, see _window_buckets
+    inf_w = tuple(b + vz for b in CJ.pt_inf((NUM_WINDOWS,)))
     (_, wsums), _ = lax.scan(agg, (inf_w, inf_w), xs)
 
     # Horner over windows from the top: T = 2^8 T + W_w
@@ -110,7 +115,8 @@ def _finish(bx, by, bz):
         total = lax.fori_loop(0, WINDOW_BITS, lambda i, t: CJ.jac_double(t), total)
         return CJ.jac_add(total, w), None
 
-    total, _ = lax.scan(comb, CJ.pt_inf(()), ws)
+    total0 = tuple(b + vz for b in CJ.pt_inf(()))
+    total, _ = lax.scan(comb, total0, ws)
     return total
 
 

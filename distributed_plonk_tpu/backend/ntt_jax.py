@@ -40,6 +40,30 @@ def _powers(base, count, start=1):
     return out
 
 
+def batched_butterflies(v, perm, tables):
+    """Radix-2 DIT butterflies on a batch of rows.
+
+    v: (16, B, n) Montgomery limbs; perm: (n,) bit-reversal index;
+    tables: per-stage (16, m) Montgomery twiddles. Shared by the
+    single-device kernel and the mesh 4-step NTT's row/column stages.
+    """
+    n = v.shape[2]
+    if n == 1:
+        return v
+    b = v.shape[1]
+    v = v[:, :, perm]
+    for tw in tables:
+        m = tw.shape[1]
+        blocks = n // (2 * m)
+        v = v.reshape(FR_LIMBS, b, blocks, 2, m)
+        u = v[:, :, :, 0, :]
+        t = v[:, :, :, 1, :]
+        t = FJ.mont_mul(FR, t, tw[:, None, None, :])
+        v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=3)
+        v = v.reshape(FR_LIMBS, b, n)
+    return v
+
+
 class NttPlan:
     """Precomputed tables + cached jitted kernels for one domain size."""
 
@@ -106,18 +130,8 @@ class NttPlan:
                     v = FJ.to_mont(FR, v)
                 if "pre" in consts:
                     v = FJ.mont_mul(FR, v, consts["pre"])
-                if n > 1:
-                    v = v[:, consts["perm"]]
-                for tw in consts["tables"]:
-                    m = tw.shape[1]
-                    blocks = n // (2 * m)
-                    v = v.reshape(FR_LIMBS, blocks, 2, m)
-                    u = v[:, :, 0, :]
-                    t = v[:, :, 1, :]
-                    twb = jnp.broadcast_to(tw[:, None, :], t.shape)
-                    t = FJ.mont_mul(FR, t, twb)
-                    v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=2)
-                    v = v.reshape(FR_LIMBS, n)
+                v = batched_butterflies(
+                    v[:, None, :], consts["perm"], consts["tables"])[:, 0, :]
                 if "post" in consts:
                     post = consts["post"]
                     if post.shape[1] == 1:  # plain 1/n: broadcast symbolically

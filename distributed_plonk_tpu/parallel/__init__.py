@@ -1,0 +1,10 @@
+"""Mesh-parallel compute: the TPU-native replacement for the reference's
+worker fleet + Cap'n Proto collectives (SURVEY.md §2.4).
+
+The reference moves FFT panels between workers over TCP (fftExchange
+all-to-all, /root/reference/src/worker.rs:293-344,412-438) and sum-reduces
+MSM partials on the dispatcher (/root/reference/src/dispatcher2.rs:888-890).
+Here the same dataflow is expressed as XLA collectives over a
+jax.sharding.Mesh: `all_to_all` for the 4-step NTT transpose, `all_gather`
++ on-device fold for the MSM partial reduction — no host round-trips.
+"""

@@ -1,0 +1,30 @@
+"""Device mesh construction.
+
+Replaces the reference's network config (`config/network.json` +
+/root/reference/src/config.rs:5-9): where the reference enumerates worker
+socket addresses, the TPU build enumerates devices on one axis of a
+jax.sharding.Mesh. Multi-host extension happens by initializing
+jax.distributed and letting jax.devices() span hosts (DCN), with the same
+mesh axis semantics.
+"""
+
+import numpy as np
+import jax
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(n_devices=None, platform=None):
+    """1-D mesh over the first n_devices (default: all) devices.
+
+    platform: None = jax's default backend. On hosts where a TPU plugin
+    outranks JAX_PLATFORMS (e.g. the axon tunnel exposes 1 real chip),
+    pass platform="cpu" to build the N-device virtual host mesh
+    (--xla_force_host_platform_device_count).
+    """
+    devs = jax.devices(platform) if platform else jax.devices()
+    if n_devices is not None:
+        assert len(devs) >= n_devices, (
+            f"need {n_devices} {platform or 'default'} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return jax.sharding.Mesh(np.array(devs), (SHARD_AXIS,))

@@ -1,0 +1,109 @@
+"""Sharded variable-base MSM over a device mesh.
+
+TPU-native replacement for the reference's distributed MSM
+(/root/reference/src/dispatcher2.rs:834-893 + src/worker.rs:159-185):
+bases and scalars are range-sharded across the mesh (the MsmWorkload
+convention, with the v1 full-coverage semantics — SURVEY.md §2.3.1),
+every device runs the sort-free Pippenger bucket pipeline on its slice,
+and the partial G1 sums fold ON DEVICE via all_gather + a tiny scan —
+replacing the reference's host-side sum-reduce (dispatcher2.rs:888-890).
+(G1 addition is not a ring sum, so `psum` does not apply; the
+all_gather+fold is the collective equivalent.)
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..constants import FQ_MONT_R, Q_MOD, R_MOD, FR_LIMBS, FQ_LIMBS
+from ..backend import curve_jax as CJ
+from ..backend import msm_jax
+from ..backend.limbs import ints_to_limbs
+from .mesh import SHARD_AXIS
+
+
+class MeshMsmContext:
+    """Device-mesh-resident base set: every device holds its contiguous
+    1/D range of the SRS (the v1 init semantics the rebuild standardizes
+    on, /root/reference/src/dispatcher.rs:572-578)."""
+
+    def __init__(self, mesh, bases_affine):
+        self.mesh = mesh
+        d = mesh.devices.size
+        n = len(bases_affine)
+        self.n = n
+        # pad so every shard is non-trivially groupable
+        pad = (-n) % (2 * d)
+        self.padded_n = n + pad
+        self.local_n = self.padded_n // d
+        self.group = msm_jax._group_size(self.local_n)
+
+        xs, ys, infs = [], [], []
+        for p in bases_affine:
+            if p is None:
+                xs.append(0)
+                ys.append(0)
+                infs.append(True)
+            else:
+                xs.append(p[0] * FQ_MONT_R % Q_MOD)
+                ys.append(p[1] * FQ_MONT_R % Q_MOD)
+                infs.append(False)
+        xs += [0] * pad
+        ys += [0] * pad
+        infs += [True] * pad
+        shard_nd = jax.sharding.NamedSharding(mesh, P(None, SHARD_AXIS))
+        x = jax.device_put(ints_to_limbs(xs, FQ_LIMBS), shard_nd)
+        y = jax.device_put(ints_to_limbs(ys, FQ_LIMBS), shard_nd)
+        inf = jax.device_put(np.array(infs), jax.sharding.NamedSharding(mesh, P(SHARD_AXIS)))
+        self.point = jax.jit(CJ.from_affine)(x, y, inf)
+
+        shard = P(None, SHARD_AXIS)
+        digit_spec = P(None, SHARD_AXIS)
+
+        def body(px, py, pz, digits):
+            # local slice: (24, local_n); digits (32, local_n)
+            wb = jax.vmap(partial(msm_jax._window_buckets, group=self.group),
+                          in_axes=(None, None, None, 0))(px, py, pz, digits)
+            bx, by, bz = (b.transpose(1, 0, 2) for b in wb)
+            tx, ty, tz = msm_jax._finish(bx, by, bz)
+            # fold the D partial sums on device (reference folds on the
+            # dispatcher host instead)
+            gx = lax.all_gather(tx, SHARD_AXIS)  # (D, 24)
+            gy = lax.all_gather(ty, SHARD_AXIS)
+            gz = lax.all_gather(tz, SHARD_AXIS)
+
+            def red(acc, g):
+                return CJ.jac_add(acc, g), None
+
+            vz = gz.ravel()[0] & 0  # varying-zero, see msm_jax._window_buckets
+            init = tuple(b + vz for b in CJ.pt_inf(()))
+            total, _ = lax.scan(red, init, (gx, gy, gz))
+            return total
+
+        # check_vma=False: the all_gather+fold makes the outputs replicated
+        # in value, which the varying-axes checker cannot infer statically
+        self._fn = jax.jit(_shard_map(
+            body, mesh=mesh,
+            in_specs=(shard, shard, shard, digit_spec),
+            out_specs=(P(None), P(None), P(None)), check_vma=False))
+
+    def msm(self, scalars):
+        """Σ scalars_i * bases_i -> affine point (host ints) or None."""
+        assert len(scalars) <= self.n
+        scalars = [s % R_MOD for s in scalars]
+        scalars += [0] * (self.padded_n - len(scalars))
+        limbs = ints_to_limbs(scalars, FR_LIMBS)
+        digits = np.stack([limbs & 0xFF, limbs >> 8], axis=1).astype(np.uint32)
+        digits = digits.reshape(msm_jax.NUM_WINDOWS, self.padded_n)
+        px, py, pz = self.point
+        tx, ty, tz = self._fn(px, py, pz, digits)
+        return msm_jax._jac_limbs_to_affine(tx, ty, tz)

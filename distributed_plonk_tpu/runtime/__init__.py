@@ -1,0 +1,9 @@
+"""Host runtime: native data plane + multi-host control plane.
+
+The TPU-native replacement for the reference's worker/dispatcher runtime
+(SURVEY.md L1-L2): a C++ data-plane/transport library (native/dpt_native.cpp)
+loaded via ctypes, a network config, a worker daemon, and a dispatcher
+client. Intra-pod compute never touches this path (XLA collectives over
+ICI); this layer carries the host-level control plane and DCN bulk data,
+like the reference's capnp plane did for everything.
+"""

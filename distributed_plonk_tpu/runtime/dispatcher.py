@@ -1,0 +1,143 @@
+"""Dispatcher client: drives a worker fleet over the native transport.
+
+The analog of the reference's dispatcher client library
+(/root/reference/src/dispatcher.rs:29-175) + the v2 distributed compute
+entry points (`Prover::fft` dispatcher2.rs:731-787, `commit_polynomial`
+dispatcher2.rs:834-893), with the sharding convention fixed: every worker
+receives exactly the base chunk its scalar range covers (the reference
+mixed v1 full-broadcast with v2 chunking and indexed out of bounds —
+SURVEY.md §2.3.1).
+"""
+
+import concurrent.futures as futures
+import threading
+
+from . import native, protocol
+from .. import curve as C
+
+
+class WorkerHandle:
+    def __init__(self, host, port):
+        self.conn = native.connect(host, port)
+        # one in-flight request per connection: frames are not interleavable
+        self._lock = threading.Lock()
+
+    def call(self, tag, payload=b""):
+        with self._lock:
+            self.conn.send(tag, payload)
+            rtag, rpayload = self.conn.recv()
+        if rtag != protocol.OK:
+            raise RuntimeError(f"worker error: {rpayload!r}")
+        return rpayload
+
+    def close(self):
+        self.conn.close()
+
+
+class Dispatcher:
+    """Connections to every worker + distributed MSM / NTT offload."""
+
+    def __init__(self, config):
+        self.workers = [WorkerHandle(h, p) for h, p in config.workers]
+        self.pool = futures.ThreadPoolExecutor(max_workers=len(self.workers))
+        self._ranges = None
+
+    def ping(self):
+        for w in self.workers:
+            w.call(protocol.PING)
+
+    def init_bases(self, bases):
+        """Range-shard the SRS: worker i holds bases[start_i:end_i]
+        (contiguous split, like MsmWorkload ranges)."""
+        n = len(bases)
+        k = len(self.workers)
+        bounds = [n * i // k for i in range(k + 1)]
+        self._ranges = list(zip(bounds[:-1], bounds[1:]))
+        list(self.pool.map(
+            lambda iw: iw[1].call(protocol.INIT_BASES,
+                                  protocol.encode_points(
+                                      bases[self._ranges[iw[0]][0]:
+                                            self._ranges[iw[0]][1]])),
+            enumerate(self.workers)))
+
+    def msm(self, scalars):
+        """Distributed MSM: scatter scalar ranges, fold partial G1 sums on
+        the host (reference dispatcher2.rs:888-890)."""
+        assert self._ranges is not None, "init_bases first"
+
+        def part(iw):
+            i, w = iw
+            start, end = self._ranges[i]
+            chunk = scalars[start:end]
+            if not chunk:
+                return None
+            raw = w.call(protocol.MSM, protocol.encode_scalars(chunk))
+            return protocol.decode_point(raw)
+
+        total = None
+        for p in self.pool.map(part, enumerate(self.workers)):
+            total = C.g1_add_affine(total, p)
+        return total
+
+    def ntt(self, values, inverse=False, coset=False, worker=0):
+        """Offload one whole NTT to a worker (per-polynomial task
+        parallelism, reference §2.3.3)."""
+        raw = self.workers[worker % len(self.workers)].call(
+            protocol.NTT, protocol.encode_ntt_request(values, inverse, coset))
+        return protocol.decode_scalars(raw)
+
+    def ntt_many(self, jobs):
+        """Round-robin a batch of NTT jobs [(values, inverse, coset), ...]
+        across the fleet concurrently (the join_all pattern,
+        reference dispatcher2.rs:294-321)."""
+        return list(self.pool.map(
+            lambda ij: self.ntt(ij[1][0], ij[1][1], ij[1][2], worker=ij[0]),
+            enumerate(jobs)))
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                w.call(protocol.SHUTDOWN)
+            except Exception:
+                pass
+            w.close()
+
+
+class RemoteBackend:
+    """Prover backend that routes every FFT/MSM through the worker fleet —
+    the v2 fully-distributed prove path (reference dispatcher2.rs:192-713)."""
+
+    name = "remote"
+
+    def __init__(self, dispatcher):
+        self.d = dispatcher
+        self._inited = None
+
+    def _ensure_bases(self, bases):
+        if self._inited is not bases:
+            self.d.init_bases(bases)
+            self._inited = bases
+
+    def fft(self, domain, values):
+        return self._ntt(domain, values, False, False)
+
+    def ifft(self, domain, values):
+        return self._ntt(domain, values, True, False)
+
+    def coset_fft(self, domain, values):
+        return self._ntt(domain, values, False, True)
+
+    def coset_ifft(self, domain, values):
+        return self._ntt(domain, values, True, True)
+
+    def _ntt(self, domain, values, inverse, coset):
+        padded = list(values) + [0] * (domain.size - len(values))
+        return self.d.ntt(padded, inverse, coset)
+
+    def msm(self, bases, scalars):
+        self._ensure_bases(bases)
+        padded = list(scalars) + [0] * (len(bases) - len(scalars))
+        return self.d.msm(padded)
+
+    def commit(self, ck, coeffs):
+        return self.msm(ck, coeffs)

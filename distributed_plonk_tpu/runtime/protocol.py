@@ -1,0 +1,81 @@
+"""Wire protocol: frame tags + payload codecs.
+
+The explicit replacement for the reference's capnp schema
+(/root/reference/src/hello_world.capnp): the implemented subset maps to the
+reference's live RPCs (init/varMsm/fft*); the 12 methods the reference
+declared but never implemented (hello_world.capnp:26-44) are deliberately
+absent — device-resident rounds make them unnecessary.
+
+All integers little-endian. Field elements are 32-byte LE; G1 affine points
+are x(48B LE) || y(48B LE) || inf(u8).
+"""
+
+import struct
+
+from ..constants import R_MOD
+
+# tags
+PING = 1
+INIT_BASES = 2     # u64 n, then n * 97B points       -> reply OK
+MSM = 3            # u64 count, count * 32B scalars    -> reply 97B point
+NTT = 4            # u8 flags (1=inverse, 2=coset), u64 n, n * 32B elements
+                   #                                   -> reply n * 32B
+SHUTDOWN = 5
+OK = 100
+ERR = 101
+
+FR_BYTES = 32
+FQ_BYTES = 48
+POINT_BYTES = 2 * FQ_BYTES + 1
+
+
+def encode_scalars(scalars):
+    return b"".join(int(s % R_MOD).to_bytes(FR_BYTES, "little") for s in scalars)
+
+
+def decode_scalars(raw):
+    n = len(raw) // FR_BYTES
+    return [int.from_bytes(raw[i * FR_BYTES:(i + 1) * FR_BYTES], "little")
+            for i in range(n)]
+
+
+def encode_point(p):
+    if p is None:
+        return bytes(POINT_BYTES - 1) + b"\x01"
+    return (p[0].to_bytes(FQ_BYTES, "little")
+            + p[1].to_bytes(FQ_BYTES, "little") + b"\x00")
+
+
+def decode_point(raw):
+    assert len(raw) == POINT_BYTES
+    if raw[-1]:
+        return None
+    return (int.from_bytes(raw[:FQ_BYTES], "little"),
+            int.from_bytes(raw[FQ_BYTES:2 * FQ_BYTES], "little"))
+
+
+def encode_points(points):
+    return struct.pack("<Q", len(points)) + b"".join(
+        encode_point(p) for p in points)
+
+
+def decode_points(raw):
+    (n,) = struct.unpack_from("<Q", raw, 0)
+    out = []
+    off = 8
+    for _ in range(n):
+        out.append(decode_point(raw[off:off + POINT_BYTES]))
+        off += POINT_BYTES
+    return out
+
+
+def encode_ntt_request(values, inverse, coset):
+    flags = (1 if inverse else 0) | (2 if coset else 0)
+    return (struct.pack("<BQ", flags, len(values))
+            + encode_scalars(values))
+
+
+def decode_ntt_request(raw):
+    flags, n = struct.unpack_from("<BQ", raw, 0)
+    values = decode_scalars(raw[9:9 + n * FR_BYTES])
+    return values, bool(flags & 1), bool(flags & 2)

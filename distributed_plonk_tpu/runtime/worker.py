@@ -1,0 +1,133 @@
+"""Worker daemon: serves MSM/NTT over the native framed transport.
+
+The analog of the reference's worker binary (/root/reference/src/worker.rs:
+441-536): holds device-resident SRS state across requests (State,
+worker.rs:42-59), executes kernels per RPC. Threading model: one thread per
+dispatcher connection, state guarded by a lock — replacing the reference's
+single-thread-plus-unsafe-aliasing design (worker.rs:135 etc.) with an
+actually sound one.
+
+Run: python -m distributed_plonk_tpu.runtime.worker <index> [config.json]
+    [--backend python|jax]
+"""
+
+import sys
+import threading
+
+from . import native, protocol
+from .netconfig import NetworkConfig
+from ..poly import Domain
+
+
+def _make_backend(name):
+    if name == "jax":
+        from ..backend.jax_backend import JaxBackend
+        return JaxBackend()
+    from ..backend.python_backend import PythonBackend
+    return PythonBackend()
+
+
+class WorkerState:
+    def __init__(self, backend):
+        self.backend = backend
+        self.bases = None
+        self.lock = threading.Lock()
+        self.domains = {}
+
+    def domain(self, n):
+        if n not in self.domains:
+            self.domains[n] = Domain(n)
+        return self.domains[n]
+
+
+def handle(conn, state):
+    """Serve one connection until EOF/shutdown. Returns False to stop the
+    whole daemon."""
+    while True:
+        try:
+            tag, payload = conn.recv()
+        except ConnectionError:
+            return True
+        try:
+            cont = _dispatch(conn, state, tag, payload)
+        except Exception as e:  # malformed payload / backend failure
+            try:
+                conn.send(protocol.ERR, repr(e).encode())
+            except ConnectionError:
+                return True
+            continue
+        if cont is False:
+            return False
+
+
+def _dispatch(conn, state, tag, payload):
+        if tag == protocol.PING:
+            conn.send(protocol.OK)
+        elif tag == protocol.INIT_BASES:
+            with state.lock:
+                state.bases = protocol.decode_points(payload)
+            conn.send(protocol.OK)
+        elif tag == protocol.MSM:
+            scalars = protocol.decode_scalars(payload)
+            with state.lock:
+                if state.bases is None:
+                    conn.send(protocol.ERR, b"no bases")
+                    continue
+                result = state.backend.msm(state.bases, scalars)
+            conn.send(protocol.OK, protocol.encode_point(result))
+        elif tag == protocol.NTT:
+            values, inverse, coset = protocol.decode_ntt_request(payload)
+            domain = state.domain(len(values))
+            with state.lock:
+                if inverse and coset:
+                    out = state.backend.coset_ifft(domain, values)
+                elif inverse:
+                    out = state.backend.ifft(domain, values)
+                elif coset:
+                    out = state.backend.coset_fft(domain, values)
+                else:
+                    out = state.backend.fft(domain, values)
+            conn.send(protocol.OK, protocol.encode_scalars(out))
+        elif tag == protocol.SHUTDOWN:
+            conn.send(protocol.OK)
+            return False
+        else:
+            conn.send(protocol.ERR, b"unknown tag")
+
+
+def serve(index, config, backend_name="python", ready_event=None):
+    host, port = config.workers[index]
+    listener = native.Listener(host, port)
+    state = WorkerState(_make_backend(backend_name))
+    if ready_event is not None:
+        ready_event.set()
+    stop = threading.Event()
+
+    def run_conn(conn):
+        if not handle(conn, state):
+            stop.set()
+        conn.close()
+
+    def accept_loop():
+        while True:
+            conn = listener.accept()
+            if conn.fd < 0:
+                return
+            threading.Thread(target=run_conn, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    stop.wait()  # SHUTDOWN flips this; daemon threads die with the process
+    listener.close()
+
+
+def main(argv):
+    index = int(argv[0])
+    cfg_path = argv[1] if len(argv) > 1 else "config/network.json"
+    backend = "python"
+    if "--backend" in argv:
+        backend = argv[argv.index("--backend") + 1]
+    serve(index, NetworkConfig.load(cfg_path), backend)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

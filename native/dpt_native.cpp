@@ -1,0 +1,175 @@
+// Native host data-plane + transport for distributed_plonk_tpu.
+//
+// Plays the role of the reference's native host components:
+//   - zero-copy workload serialization (/root/reference/src/utils.rs:27-43)
+//     -> here an explicit, layout-documented limb codec (no unsafe
+//        transmutes: the wire format is defined, not accidental)
+//   - CPU transpose kernels (/root/reference/src/transpose.rs)
+//     -> blocked uint32 transpose for host-side panel reassembly
+//   - Cap'n Proto two-party TCP RPC (/root/reference/src/worker.rs:441-536)
+//     -> a minimal length-prefixed framed message transport (TCP_NODELAY),
+//        enough to express the dispatcher<->worker control plane; bulk
+//        data rides the same frames
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this environment).
+//
+// Wire format: frame = [u64 payload_len (LE)][u32 tag (LE)][payload bytes].
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cerrno>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+
+// --- limb codec --------------------------------------------------------------
+// elements: n little-endian byte strings of elem_bytes each, concatenated.
+// limbs: uint32 matrix, leading-limb layout (n_limbs, n) row-major, 16-bit
+// limbs (the device layout, see distributed_plonk_tpu/backend/limbs.py).
+
+void le_bytes_to_limbs(const uint8_t* in, uint64_t n, uint64_t elem_bytes,
+                       uint32_t* out) {
+    const uint64_t n_limbs = elem_bytes / 2;
+    for (uint64_t i = 0; i < n; ++i) {
+        const uint8_t* e = in + i * elem_bytes;
+        for (uint64_t l = 0; l < n_limbs; ++l) {
+            out[l * n + i] =
+                (uint32_t)e[2 * l] | ((uint32_t)e[2 * l + 1] << 8);
+        }
+    }
+}
+
+// returns 0 on success, -1 if any limb value exceeds 16 bits (unreduced
+// kernel output -- the same guard limbs.py applies at the oracle boundary)
+int limbs_to_le_bytes(const uint32_t* in, uint64_t n, uint64_t elem_bytes,
+                      uint8_t* out) {
+    const uint64_t n_limbs = elem_bytes / 2;
+    for (uint64_t l = 0; l < n_limbs; ++l) {
+        const uint32_t* row = in + l * n;
+        for (uint64_t i = 0; i < n; ++i) {
+            uint32_t v = row[i];
+            if (v > 0xFFFFu) return -1;
+            out[i * elem_bytes + 2 * l] = (uint8_t)(v & 0xFF);
+            out[i * elem_bytes + 2 * l + 1] = (uint8_t)(v >> 8);
+        }
+    }
+    return 0;
+}
+
+// --- blocked transpose -------------------------------------------------------
+// (rows, cols) -> (cols, rows), 64x64 tiles (cache-friendly; the reference's
+// oop_transpose_medium plays this role, transpose.rs:110-198)
+
+void transpose_u32(const uint32_t* in, uint64_t rows, uint64_t cols,
+                   uint32_t* out) {
+    const uint64_t T = 64;
+    for (uint64_t r0 = 0; r0 < rows; r0 += T) {
+        const uint64_t r1 = r0 + T < rows ? r0 + T : rows;
+        for (uint64_t c0 = 0; c0 < cols; c0 += T) {
+            const uint64_t c1 = c0 + T < cols ? c0 + T : cols;
+            for (uint64_t r = r0; r < r1; ++r)
+                for (uint64_t c = c0; c < c1; ++c)
+                    out[c * rows + r] = in[r * cols + c];
+        }
+    }
+}
+
+// --- framed TCP transport ----------------------------------------------------
+
+static int read_exact(int fd, uint8_t* buf, uint64_t len) {
+    uint64_t got = 0;
+    while (got < len) {
+        ssize_t k = read(fd, buf + got, len - got);
+        if (k <= 0) {
+            if (k < 0 && errno == EINTR) continue;
+            return -1;
+        }
+        got += (uint64_t)k;
+    }
+    return 0;
+}
+
+static int write_exact(int fd, const uint8_t* buf, uint64_t len) {
+    uint64_t put = 0;
+    while (put < len) {
+        ssize_t k = write(fd, buf + put, len - put);
+        if (k <= 0) {
+            if (k < 0 && errno == EINTR) continue;
+            return -1;
+        }
+        put += (uint64_t)k;
+    }
+    return 0;
+}
+
+// listener: returns listening fd or -1
+int dpt_listen(const char* host, int port, int backlog) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) { close(fd); return -1; }
+    if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { close(fd); return -1; }
+    if (listen(fd, backlog) != 0) { close(fd); return -1; }
+    return fd;
+}
+
+int dpt_accept(int listen_fd) {
+    int fd = accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) return -1;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return fd;
+}
+
+int dpt_connect(const char* host, int port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) { close(fd); return -1; }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { close(fd); return -1; }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return fd;
+}
+
+// send one frame; returns 0 / -1
+int dpt_send(int fd, uint32_t tag, const uint8_t* payload, uint64_t len) {
+    uint8_t hdr[12];
+    memcpy(hdr, &len, 8);
+    memcpy(hdr + 8, &tag, 4);
+    if (write_exact(fd, hdr, 12) != 0) return -1;
+    if (len && write_exact(fd, payload, len) != 0) return -1;
+    return 0;
+}
+
+// peek the next frame header; returns 0 and fills len/tag, or -1
+int dpt_recv_header(int fd, uint64_t* len, uint32_t* tag) {
+    uint8_t hdr[12];
+    if (read_exact(fd, hdr, 12) != 0) return -1;
+    memcpy(len, hdr, 8);
+    memcpy(tag, hdr + 8, 4);
+    return 0;
+}
+
+// read the payload announced by dpt_recv_header into caller buffer
+int dpt_recv_payload(int fd, uint8_t* buf, uint64_t len) {
+    return read_exact(fd, buf, len);
+}
+
+int dpt_close(int fd) { return close(fd); }
+
+}  // extern "C"

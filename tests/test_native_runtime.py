@@ -1,0 +1,119 @@
+"""Native data plane (C++ limb codec/transpose) + framed transport +
+distributed worker/dispatcher runtime.
+
+The runtime analog of the reference's distributed tests (test_msm
+/root/reference/src/dispatcher.rs:177-244, test_fft :246-350, test2
+dispatcher2.rs:1273-1295) — but against an in-process localhost fleet
+(SURVEY.md §4's "missing piece"), not a hand-provisioned LAN.
+"""
+
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from distributed_plonk_tpu import curve as C
+from distributed_plonk_tpu import poly as P
+from distributed_plonk_tpu.constants import R_MOD
+from distributed_plonk_tpu.backend.limbs import ints_to_limbs
+from distributed_plonk_tpu.runtime import native, protocol
+from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+from distributed_plonk_tpu.runtime.dispatcher import Dispatcher, RemoteBackend
+
+RNG = random.Random(0xD15)
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# --- data plane --------------------------------------------------------------
+
+def test_native_limb_codec_matches_python():
+    vals = [RNG.randrange(R_MOD) for _ in range(100)]
+    raw = b"".join(v.to_bytes(32, "little") for v in vals)
+    got = native.bytes_to_limbs(raw, 100, 32)
+    assert np.array_equal(got, ints_to_limbs(vals, 16))
+    assert native.limbs_to_bytes(got) == raw
+
+
+def test_native_limb_codec_rejects_unreduced():
+    bad = np.full((16, 4), 0x10000, dtype=np.uint32)
+    with pytest.raises(ValueError):
+        native.limbs_to_bytes(bad)
+
+
+def test_native_transpose():
+    a = np.arange(96 * 130, dtype=np.uint32).reshape(96, 130)
+    assert np.array_equal(native.transpose(a), a.T)
+
+
+# --- transport + fleet -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    cfg_path = str(tmp_path_factory.mktemp("rt") / "network.json")
+    base = 19000 + (os.getpid() % 500) * 2
+    cfg = NetworkConfig([f"127.0.0.1:{base}", f"127.0.0.1:{base + 1}"])
+    cfg.save(cfg_path)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
+             str(i), cfg_path, "--backend", "python"],
+            cwd=REPO)
+        for i in range(2)
+    ]
+    # wait for both listeners
+    d = None
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            d = Dispatcher(cfg)
+            d.ping()
+            break
+        except (ConnectionError, OSError):
+            time.sleep(0.3)
+            d = None
+    assert d is not None, "workers did not come up"
+    yield d
+    d.shutdown()
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def test_distributed_msm(fleet):
+    n = 64
+    bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD)) for _ in range(n - 1)]
+    bases.append(None)
+    scalars = [RNG.randrange(R_MOD) for _ in range(n - 1)] + [0]
+    fleet.init_bases(bases)
+    assert fleet.msm(scalars) == C.g1_msm(bases, scalars)
+
+
+def test_distributed_ntt_all_modes(fleet):
+    n = 64
+    domain = P.Domain(n)
+    values = [RNG.randrange(R_MOD) for _ in range(n)]
+    assert fleet.ntt(values) == P.fft(domain, values)
+    assert fleet.ntt(values, inverse=True) == P.ifft(domain, values)
+    assert fleet.ntt(values, coset=True) == P.coset_fft(domain, values)
+    assert fleet.ntt(values, inverse=True, coset=True) == P.coset_ifft(domain, values)
+    jobs = [(values, False, False), (values, True, False), (values, False, True)]
+    got = fleet.ntt_many(jobs)
+    assert got == [P.fft(domain, values), P.ifft(domain, values),
+                   P.coset_fft(domain, values)]
+
+
+def test_remote_prove_matches_oracle(fleet, proven):
+    """Fully-distributed prove through the worker fleet == host proof
+    (the reference's test2 invariant)."""
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.verifier import verify
+
+    ckt, pk, vk, proof_host = proven
+    proof = prove(random.Random(1), ckt, pk, RemoteBackend(fleet))
+    assert verify(vk, ckt.public_input(), proof, rng=random.Random(2))
+    assert proof.opening_proof == proof_host.opening_proof
+    assert proof.wires_poly_comms == proof_host.wires_poly_comms
+    assert proof.split_quot_poly_comms == proof_host.split_quot_poly_comms
