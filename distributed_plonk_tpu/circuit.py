@@ -32,6 +32,8 @@ Q_O = 10
 Q_C = 11
 Q_ECC = 12
 
+_INV_5 = pow(5, -1, R_MOD - 1)  # x -> x^(1/5) exponent (gcd(5, r-1) = 1)
+
 
 def coset_representatives(num):
     """Wire-subset separators k_0=1, k_i = g^i (g = 7, a primitive root).
@@ -145,6 +147,53 @@ class PlonkCircuit:
         self._add_gate([a, self.zero_var, self.zero_var, self.zero_var, out],
                        {Q_HASH: 1, Q_O: 1})
         return out
+
+    def root5(self, a):
+        """out with out^5 == a (one gate, S-box run backwards: the witness
+        carries the 5th root, the q_hash selector enforces the power)."""
+        out = self.create_variable(pow(self.witness[a], _INV_5, R_MOD))
+        self._add_gate([out, self.zero_var, self.zero_var, self.zero_var, a],
+                       {Q_HASH: 1, Q_O: 1})
+        return out
+
+    def lc_with_const(self, vars4, coeffs4, const):
+        """out = sum coeffs4[i]*vars4[i] + const (one gate)."""
+        val = sum(c * self.witness[v] for v, c in zip(vars4, coeffs4)) + const
+        out = self.create_variable(val)
+        sel = {Q_LC + i: coeffs4[i] % R_MOD for i in range(4)}
+        sel[Q_C] = const % R_MOD
+        sel[Q_O] = 1
+        self._add_gate(list(vars4) + [out], sel)
+        return out
+
+    def pow5_lc_with_const(self, vars4, coeffs4, const):
+        """out = sum coeffs4[i]*vars4[i]^5 + const (one gate).
+
+        The TurboPlonk hash selectors q_hash0..3 weight the 5th powers of all
+        four input wires, so a Rescue forward half-round's S-box + one MDS row
+        + round constant fuse into a single gate (the gate shape jf-plonk's
+        RescueGadget was built around; cf. the q_hash terms of the quotient
+        formula at /root/reference/src/dispatcher2.rs:469-473)."""
+        val = sum(c * pow(self.witness[v], 5, R_MOD)
+                  for v, c in zip(vars4, coeffs4)) + const
+        out = self.create_variable(val)
+        sel = {Q_HASH + i: coeffs4[i] % R_MOD for i in range(4)}
+        sel[Q_C] = const % R_MOD
+        sel[Q_O] = 1
+        self._add_gate(list(vars4) + [out], sel)
+        return out
+
+    def mul_add(self, a, b, c, d):
+        """out = a*b + c*d (one gate via the two q_mul selectors)."""
+        out = self.create_variable(
+            self.witness[a] * self.witness[b] + self.witness[c] * self.witness[d])
+        self._add_gate([a, b, c, d, out], {Q_MUL: 1, Q_MUL + 1: 1, Q_O: 1})
+        return out
+
+    def enforce_bool(self, a):
+        """Constrain a in {0,1}: a*a - a == 0 (one gate)."""
+        self._add_gate([a, a, self.zero_var, self.zero_var, self.zero_var],
+                       {Q_MUL: 1, Q_LC: R_MOD - 1})
 
     def enforce_equal(self, a, b):
         self._add_gate([a, b, self.zero_var, self.zero_var, self.zero_var],
