@@ -61,38 +61,41 @@ def handle(conn, state):
 
 
 def _dispatch(conn, state, tag, payload):
-        if tag == protocol.PING:
-            conn.send(protocol.OK)
-        elif tag == protocol.INIT_BASES:
-            with state.lock:
-                state.bases = protocol.decode_points(payload)
-            conn.send(protocol.OK)
-        elif tag == protocol.MSM:
-            scalars = protocol.decode_scalars(payload)
-            with state.lock:
-                if state.bases is None:
-                    conn.send(protocol.ERR, b"no bases")
-                    continue
-                result = state.backend.msm(state.bases, scalars)
-            conn.send(protocol.OK, protocol.encode_point(result))
-        elif tag == protocol.NTT:
-            values, inverse, coset = protocol.decode_ntt_request(payload)
+    """Handle one request frame. Returns False to stop the daemon, anything
+    else to keep serving."""
+    if tag == protocol.PING:
+        conn.send(protocol.OK)
+    elif tag == protocol.INIT_BASES:
+        with state.lock:
+            state.bases = protocol.decode_points(payload)
+        conn.send(protocol.OK)
+    elif tag == protocol.MSM:
+        scalars = protocol.decode_scalars(payload)
+        with state.lock:
+            if state.bases is None:
+                conn.send(protocol.ERR, b"no bases")
+                return None
+            result = state.backend.msm(state.bases, scalars)
+        conn.send(protocol.OK, protocol.encode_point(result))
+    elif tag == protocol.NTT:
+        values, inverse, coset = protocol.decode_ntt_request(payload)
+        with state.lock:
             domain = state.domain(len(values))
-            with state.lock:
-                if inverse and coset:
-                    out = state.backend.coset_ifft(domain, values)
-                elif inverse:
-                    out = state.backend.ifft(domain, values)
-                elif coset:
-                    out = state.backend.coset_fft(domain, values)
-                else:
-                    out = state.backend.fft(domain, values)
-            conn.send(protocol.OK, protocol.encode_scalars(out))
-        elif tag == protocol.SHUTDOWN:
-            conn.send(protocol.OK)
-            return False
-        else:
-            conn.send(protocol.ERR, b"unknown tag")
+            if inverse and coset:
+                out = state.backend.coset_ifft(domain, values)
+            elif inverse:
+                out = state.backend.ifft(domain, values)
+            elif coset:
+                out = state.backend.coset_fft(domain, values)
+            else:
+                out = state.backend.fft(domain, values)
+        conn.send(protocol.OK, protocol.encode_scalars(out))
+    elif tag == protocol.SHUTDOWN:
+        conn.send(protocol.OK)
+        return False
+    else:
+        conn.send(protocol.ERR, b"unknown tag")
+    return None
 
 
 def serve(index, config, backend_name="python", ready_event=None):

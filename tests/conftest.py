@@ -2,7 +2,8 @@
 
 Tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
 multi-chip path): JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8
-must be set before jax is imported anywhere.
+must be set before jax is imported anywhere — hence this env setup sits at
+the very top of conftest, before any project import.
 """
 
 import os
@@ -11,6 +12,11 @@ import os
 # (JAX_PLATFORMS=axon): per-op tunnel latency makes eager tests unusable, and
 # the sharding tests need the 8-device virtual mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 # The persistent compilation cache itself is configured by
 # distributed_plonk_tpu.backend.field_jax at import time.
 
@@ -53,6 +59,3 @@ def proven():
     pk, vk = kzg.preprocess(srs, ckt)
     proof = prove(random.Random(1), ckt, pk, PythonBackend())
     return ckt, pk, vk, proof
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

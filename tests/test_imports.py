@@ -1,0 +1,31 @@
+"""Smoke test: every module in the package parses and imports.
+
+Guards against shipping unparseable modules (round-1 regression:
+runtime/worker.py was committed with a SyntaxError and the fleet tests
+only caught it at fixture collection).
+"""
+
+import importlib
+import pathlib
+import pkgutil
+
+import distributed_plonk_tpu
+
+
+def test_all_modules_import():
+    root = pathlib.Path(distributed_plonk_tpu.__file__).parent
+    mods = [distributed_plonk_tpu.__name__]
+    for info in pkgutil.walk_packages([str(root)], prefix="distributed_plonk_tpu."):
+        mods.append(info.name)
+    assert len(mods) > 10
+    for name in mods:
+        importlib.import_module(name)
+
+
+def test_graft_entry_parses():
+    import ast
+
+    src = pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
+    ast.parse(src.read_text())
+    src = pathlib.Path(__file__).parent.parent / "bench.py"
+    ast.parse(src.read_text())
