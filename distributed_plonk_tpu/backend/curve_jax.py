@@ -59,47 +59,64 @@ def _dbl(spec, a):
     return FJ.add(spec, a, a)
 
 
+def _mul_lanes(pairs):
+    """Batch k independent Fq products into ONE mont_mul on a stacked lane
+    axis: the traced program contains one multiplier instance instead of k
+    (k-fold smaller XLA graphs — compile time was the round-1 multichip-gate
+    killer), and the device sees one wide op instead of k narrow ones."""
+    a = jnp.stack([x for x, _ in pairs], axis=1)
+    b = jnp.stack([y for _, y in pairs], axis=1)
+    r = FJ.mont_mul(FQ, a, b)
+    return [r[:, i] for i in range(len(pairs))]
+
+
+def _sub_lanes(pairs):
+    a = jnp.stack([x for x, _ in pairs], axis=1)
+    b = jnp.stack([y for _, y in pairs], axis=1)
+    r = FJ.sub(FQ, a, b)
+    return [r[:, i] for i in range(len(pairs))]
+
+
 def jac_double(p):
     """dbl-2009-l (a=0), identical formula to the oracle
-    (curve.py _g1_jac_double_nonzero); Z1=0 propagates to Z3=0."""
+    (curve.py _g1_jac_double_nonzero); Z1=0 propagates to Z3=0.
+    Independent products run as stacked lanes (4 multiplier instances)."""
     x1, y1, z1 = p
-    a = FJ.mont_mul(FQ, x1, x1)
-    b = FJ.mont_mul(FQ, y1, y1)
-    c = FJ.mont_mul(FQ, b, b)
-    t = FJ.add(FQ, x1, b)
-    t = FJ.mont_mul(FQ, t, t)
+    a, b = _mul_lanes([(x1, x1), (y1, y1)])
+    xb = FJ.add(FQ, x1, b)
+    c, t = _mul_lanes([(b, b), (xb, xb)])
     d = _dbl(FQ, FJ.sub(FQ, FJ.sub(FQ, t, a), c))
     e = FJ.add(FQ, _dbl(FQ, a), a)
-    f = FJ.mont_mul(FQ, e, e)
+    f, yz = _mul_lanes([(e, e), (y1, z1)])
     x3 = FJ.sub(FQ, f, _dbl(FQ, d))
     c8 = _dbl(FQ, _dbl(FQ, _dbl(FQ, c)))
-    y3 = FJ.sub(FQ, FJ.mont_mul(FQ, e, FJ.sub(FQ, d, x3)), c8)
-    z3 = _dbl(FQ, FJ.mont_mul(FQ, y1, z1))
+    (g,) = _mul_lanes([(e, FJ.sub(FQ, d, x3))])
+    y3 = FJ.sub(FQ, g, c8)
+    z3 = _dbl(FQ, yz)
     return (x3, y3, z3)
 
 
 def jac_add(p, q):
     """add-2007-bl with branch-free edge handling (P==Q -> double,
-    P==-Q -> infinity, either infinite -> other operand)."""
+    P==-Q -> infinity, either infinite -> other operand).
+    Independent products run as stacked lanes (6 multiplier instances for
+    the generic sum; plus 4 in the doubling fallback)."""
     x1, y1, z1 = p
     x2, y2, z2 = q
-    z1z1 = FJ.mont_mul(FQ, z1, z1)
-    z2z2 = FJ.mont_mul(FQ, z2, z2)
-    u1 = FJ.mont_mul(FQ, x1, z2z2)
-    u2 = FJ.mont_mul(FQ, x2, z1z1)
-    s1 = FJ.mont_mul(FQ, FJ.mont_mul(FQ, y1, z2), z2z2)
-    s2 = FJ.mont_mul(FQ, FJ.mont_mul(FQ, y2, z1), z1z1)
-    h = FJ.sub(FQ, u2, u1)
-    h2 = _dbl(FQ, h)
-    i = FJ.mont_mul(FQ, h2, h2)
-    j = FJ.mont_mul(FQ, h, i)
-    rr = _dbl(FQ, FJ.sub(FQ, s2, s1))
-    v = FJ.mont_mul(FQ, u1, i)
-    x3 = FJ.sub(FQ, FJ.sub(FQ, FJ.mont_mul(FQ, rr, rr), j), _dbl(FQ, v))
-    y3 = FJ.sub(FQ, FJ.mont_mul(FQ, rr, FJ.sub(FQ, v, x3)),
-                _dbl(FQ, FJ.mont_mul(FQ, s1, j)))
     zz = FJ.add(FQ, z1, z2)
-    z3 = FJ.mont_mul(FQ, FJ.sub(FQ, FJ.sub(FQ, FJ.mont_mul(FQ, zz, zz), z1z1), z2z2), h)
+    z1z1, z2z2, zz2 = _mul_lanes([(z1, z1), (z2, z2), (zz, zz)])
+    u1, u2, s1a, s2a = _mul_lanes(
+        [(x1, z2z2), (x2, z1z1), (y1, z2), (y2, z1)])
+    s1, s2 = _mul_lanes([(s1a, z2z2), (s2a, z1z1)])
+    h, r0 = _sub_lanes([(u2, u1), (s2, s1)])
+    h2 = _dbl(FQ, h)
+    rr = _dbl(FQ, r0)
+    (i,) = _mul_lanes([(h2, h2)])
+    j, v, rr2 = _mul_lanes([(h, i), (u1, i), (rr, rr)])
+    xa, za = _sub_lanes([(rr2, j), (zz2, z1z1)])
+    x3, zb = _sub_lanes([(xa, _dbl(FQ, v)), (za, z2z2)])
+    p1, p2, z3 = _mul_lanes([(rr, FJ.sub(FQ, v, x3)), (s1, j), (zb, h)])
+    y3 = FJ.sub(FQ, p1, _dbl(FQ, p2))
     res = (x3, y3, z3)
 
     p_inf = FJ.is_zero(FQ, z1)

@@ -63,6 +63,10 @@ class FieldSpec:
         # full-width -p^-1 mod 2^(16L) for the SOS reduction low half-product
         self.ninv_limbs = int_to_limbs(mont_inv, n_limbs)
         self.one_limbs = int_to_limbs(1, n_limbs)
+        # 2^(16L) - p: adding it == subtracting p, with the sweep's carry
+        # bit flagging whether the subtraction stayed nonnegative
+        self.negmod_limbs = int_to_limbs((1 << (LIMB_BITS * n_limbs)) - mod,
+                                         n_limbs)
 
 
 FR = FieldSpec("Fr", R_MOD, FR_LIMBS, FR_MONT_R2, FR_MONT_INV)
@@ -80,98 +84,116 @@ def _carry_sweep(cols):
     Returns (limbs, carry_out): limbs (K, *batch) all < 2^16, carry_out the
     overflow past the top limb (zero whenever the caller's bound guarantees
     the value fits in K limbs).
+
+    Log-depth Kogge-Stone instead of a K-step ripple chain: pre-add each
+    column's high bits into the next column (s_i = lo_i + hi_{i-1} < 2^17,
+    so the residual inter-limb carry is a single bit), then resolve the
+    bit-carry recurrence b_i = G_i | (P_i & b_{i-1}) with an associative
+    scan over (generate, propagate) pairs. Traced ops: O(log K), and the
+    work is whole-array passes (VPU-friendly) rather than per-limb rows.
     """
-    k = cols.shape[0]
-    outs = []
-    carry = jnp.zeros_like(cols[0])
-    for i in range(k):
-        v = cols[i] + carry
-        outs.append(v & LIMB_MASK)
-        carry = v >> LIMB_BITS
-    return jnp.stack(outs, axis=0), carry
+    lo = cols & LIMB_MASK
+    hi = cols >> LIMB_BITS
+    zero_row = jnp.zeros_like(hi[:1])
+    s = lo + jnp.concatenate([zero_row, hi[:-1]], axis=0)  # s_i < 2^17
+
+    def shift_down(x, k):  # x[i] -> x[i-k], zeros shifted in at the bottom
+        return jnp.concatenate([jnp.zeros_like(x[:k]), x[:-k]], axis=0)
+
+    gen = s > LIMB_MASK
+    prop = s == LIMB_MASK
+    k = 1
+    while k < s.shape[0]:  # hand-rolled KS: cheaper lowering than
+        gen = gen | (prop & shift_down(gen, k))  # associative_scan here
+        prop = prop & shift_down(prop, k)
+        k *= 2
+    b_in = shift_down(gen, 1)
+    limbs = (s + b_in) & LIMB_MASK
+    carry = hi[-1] + gen[-1]
+    return limbs, carry
+
+
+def _skew_colsum(m, shift):
+    """Anti-diagonal column sums: out[k] = Σ_i m[i, k - i - shift].
+
+    m: (rows, w, *batch). Each row i is logically shifted right by i+shift,
+    then columns are summed — computed with pure pad/reshape/slice/reduce
+    (row i of the flattened (rows, W-1) view starts at i·(W-1) = i·W - i,
+    i.e. sits i slots earlier, which IS the skew), so the traced program is
+    O(1) ops instead of an O(rows) chain of dynamic-update-slices. Entries
+    must be < 2^16 so sums of <= rows <= 48 terms stay far below 2^32.
+    """
+    rows, w = m.shape[0], m.shape[1]
+    batch = m.shape[2:]
+    pad = [(0, 0)] * m.ndim
+    pad[1] = (shift, rows)
+    mp = jnp.pad(m, pad)  # (rows, W) with W = w + shift + rows
+    W = w + shift + rows
+    flat = mp.reshape((rows * W,) + batch)
+    skewed = flat[: rows * (W - 1)].reshape((rows, W - 1) + batch)
+    return jnp.sum(skewed, axis=0, dtype=jnp.uint32)  # (W-1, *batch)
 
 
 def _mul_columns(a, b, out_limbs):
     """Carry-free column sums of the product, truncated to out_limbs limbs."""
-    la = a.shape[0]
-    lb = b.shape[0]
-    cols = jnp.zeros((out_limbs,) + a.shape[1:], dtype=jnp.uint32)
-    for i in range(min(la, out_limbs)):
-        width = min(lb, out_limbs - i)
-        p = a[i] * b[:width]  # (width, *batch), each product < 2^32
-        lo = p & LIMB_MASK
-        hi = p >> LIMB_BITS
-        cols = cols.at[i:i + width].add(lo)
-        hi_width = min(lb, out_limbs - i - 1)
-        if hi_width > 0:
-            cols = cols.at[i + 1:i + 1 + hi_width].add(hi[:hi_width])
-    return cols
+    la, lb = a.shape[0], b.shape[0]
+    p = a[:, None] * b[None, :]  # (la, lb, *batch), each product < 2^32
+    lo = _skew_colsum(p & LIMB_MASK, 0)  # cols 0 .. la+lb-2
+    hi = _skew_colsum(p >> LIMB_BITS, 1)  # cols 1 .. la+lb-1
+    lo = lo[:out_limbs]
+    hi = hi[:out_limbs]
+    if lo.shape[0] < out_limbs:
+        lo = jnp.pad(lo, [(0, out_limbs - lo.shape[0])] + [(0, 0)] * (lo.ndim - 1))
+    if hi.shape[0] < out_limbs:
+        hi = jnp.pad(hi, [(0, out_limbs - hi.shape[0])] + [(0, 0)] * (hi.ndim - 1))
+    return lo + hi
 
 
-def _mul_full(a, b):
-    """Exact product: (La, *b) x (Lb, *b) -> (La+Lb, *b) carried limbs."""
-    cols = _mul_columns(a, b, a.shape[0] + b.shape[0])
-    limbs, carry = _carry_sweep(cols)
-    del carry  # exact product fits in La+Lb limbs
-    return limbs
+def _pad_rows(a, n):
+    if a.shape[0] == n:
+        return a
+    return jnp.pad(a, [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
 
 
-def _mul_low(a, b, out_limbs):
-    """Product mod 2^(16*out_limbs), carried limbs."""
-    cols = _mul_columns(a, b, out_limbs)
-    limbs, _ = _carry_sweep(cols)
-    return limbs
+def _sweep_pair(cols_a, cols_b):
+    """Carry-sweep two column vectors in ONE vectorized sweep.
+
+    Stacks them on a lane axis so the log-depth carry machinery is traced
+    once; returns ((limbs_a, limbs_b), (carry_a, carry_b)).
+    """
+    pair = jnp.stack([cols_a, cols_b], axis=1)  # (K, 2, *batch)
+    limbs, carry = _carry_sweep(pair)
+    return (limbs[:, 0], limbs[:, 1]), (carry[0], carry[1])
 
 
-def _add_limbs(a, b):
-    """Limbwise add with carry sweep; final carry returned separately."""
-    n = max(a.shape[0], b.shape[0])
-    outs = []
-    carry = jnp.zeros_like(a[0])
-    for i in range(n):
-        v = carry
-        if i < a.shape[0]:
-            v = v + a[i]
-        if i < b.shape[0]:
-            v = v + b[i]
-        outs.append(v & LIMB_MASK)
-        carry = v >> LIMB_BITS
-    return jnp.stack(outs, axis=0), carry
+def _cond_sub_mod(spec, cols):
+    """Value of `cols` reduced once: v - p if v >= p else v  (v < 2p).
 
-
-def _sub_limbs(a, b):
-    """a - b mod 2^(16L) with final borrow flag (1 iff a < b)."""
-    n = a.shape[0]
-    outs = []
-    borrow = jnp.zeros_like(a[0])
-    for i in range(n):
-        bi = b[i] if i < b.shape[0] else jnp.zeros_like(a[i])
-        need = bi + borrow  # <= 2^16, fits
-        v = (a[i] - need) & LIMB_MASK
-        borrow = (a[i] < need).astype(jnp.uint32)
-        outs.append(v)
-    return jnp.stack(outs, axis=0), borrow
-
-
-def _cond_sub_mod(spec, t):
-    """t - p if t >= p else t  (t < 2p)."""
-    p = _bcast_const(spec.mod_limbs, t.ndim)
-    d, borrow = _sub_limbs(t, p)
-    keep = (borrow == 1)
-    return jnp.where(keep[None], t, d)
+    Takes UNCARRIED columns (< 2^23 each) and resolves both candidates with
+    a single paired sweep: lane2 adds 2^(16L) - p, whose carry-out flags
+    v >= p.
+    """
+    negp = _bcast_const(spec.negmod_limbs, cols.ndim)
+    (t, d), (_, c2) = _sweep_pair(cols, cols + negp)
+    return jnp.where((c2 != 0)[None], d, t)
 
 
 def add(spec, a, b):
-    s, carry = _add_limbs(a, b)
-    del carry  # a, b < p  =>  a+b < 2p < 2^(16L)
-    return _cond_sub_mod(spec, s)
+    """a + b mod p (inputs < p): one paired sweep."""
+    return _cond_sub_mod(spec, a + b)
 
 
 def sub(spec, a, b):
-    d, borrow = _sub_limbs(a, b)
+    """a - b mod p (inputs < p): one paired sweep.
+
+    Lane1 = a + ~b + 1 (= a-b mod 2^(16L); carries iff a >= b);
+    lane2 = lane1 + p (the wrapped-around candidate).
+    """
+    nb = (_pad_rows(b, a.shape[0]) ^ LIMB_MASK)
+    base = (a + nb).at[0].add(1)
     p = _bcast_const(spec.mod_limbs, a.ndim)
-    dp, _ = _add_limbs(d, p)  # wraps mod 2^(16L): restores a-b+p when a < b
-    return jnp.where((borrow == 1)[None], dp, d)
+    (d, dp), (c1, _) = _sweep_pair(base, base + p)
+    return jnp.where((c1 != 0)[None], d, dp)
 
 
 def neg(spec, a):
@@ -180,16 +202,26 @@ def neg(spec, a):
 
 
 def mont_mul(spec, a, b):
-    """Montgomery product: a*b*R^-1 mod p, inputs/outputs reduced (< p)."""
+    """Montgomery product: a*b*R^-1 mod p, inputs/outputs reduced (< p).
+
+    SOS with column-level accumulation: the three partial products stay as
+    uncarried column sums (each < 2^22, so sums of two < 2^23 are still
+    exact in u32) and only four short sweeps run: t mod R; m; the low-half
+    carry-out of t + m*p (those limbs are identically 0 mod R); and the
+    final reduce of the uncarried high half (t + m*p)/R, folded into
+    _cond_sub_mod's paired sweep.
+    """
     l = spec.n_limbs
-    t = _mul_full(a, b)  # 2L limbs, < p^2
+    t_cols = _mul_columns(a, b, 2 * l)  # a*b < p^2, uncarried
+    t_lo, c_t = _carry_sweep(t_cols[:l])  # exact t mod R + carry into col l
     ninv = _bcast_const(spec.ninv_limbs, a.ndim)
-    m = _mul_low(t[:l], ninv, l)  # m = (t mod R) * (-p^-1) mod R
+    m, _ = _carry_sweep(_mul_columns(t_lo, ninv, l))  # m = (t mod R)*(-p^-1) mod R
     p = _bcast_const(spec.mod_limbs, a.ndim)
-    mp = _mul_full(m, p)  # 2L limbs, < R*p
-    s, carry = _add_limbs(t, mp)  # t + m*p  ==  0 mod R,  < R*p + p^2 < R^2
-    del carry
-    return _cond_sub_mod(spec, s[l:])  # (t + m*p) / R < 2p
+    mp_cols = _mul_columns(m, p, 2 * l)  # m*p < R*p, uncarried
+    # low half of t + m*p is == 0 mod R: only its carry-out matters
+    _, c_lo = _carry_sweep(mp_cols[:l] + t_lo)
+    hi = (mp_cols[l:] + t_cols[l:]).at[0].add(c_t + c_lo)
+    return _cond_sub_mod(spec, hi)  # (t + m*p) / R < 2p
 
 
 def to_mont(spec, a):

@@ -2,24 +2,30 @@
 
 Device replacement for `ark-ec`'s rayon Pippenger as the reference workers
 run it (/root/reference/src/worker.rs:159-185). Scalars are decomposed into
-32 radix-2^8 windows; each window's 255 buckets are accumulated WITHOUT any
-sort or data-dependent scatter pattern:
+W = 256/c radix-2^c windows — c size-dependent as in standard Pippenger
+(8 bits at bench scale, smaller for small MSMs) — and each window's 2^c - 1
+buckets are accumulated WITHOUT any sort or data-dependent scatter pattern:
 
-  - points are split into G groups, each group owning a private (G, 256)
+  - points are split into G groups, each group owning a private (G, 2^c)
     bucket array;
   - a lax.scan walks n/G point-batches: gather current buckets at the
     batch's digits (one per group), one G-wide vectorized Jacobian add,
     scatter back — all writes in a step hit distinct rows, so the scan is
     race-free by construction;
-  - groups then fold sequentially (scan), buckets aggregate with the
-    standard running-sum trick (scan over 255 buckets, vectorized across
-    all 32 windows), and windows combine by Horner (8 doublings + 1 add
-    per window).
+  - group bucket-planes then fold sequentially with a scan whose body is a
+    single (24, W, 2^c)-shaped Jacobian add — the SAME body the mesh
+    version reuses to fold planes across devices, so XLA's computation
+    deduplication compiles it once;
+  - the remaining O(W * 2^c) tail (running-sum bucket aggregation,
+    2^(c*w) window weighting, final window sum) runs as two more
+    static-shape scans with no data-dependent indexing at all (see
+    `finish`).
 
-This keeps the optimal ~n adds/window of Pippenger while every compiled
-program has an O(1)-size trace (limb math is unrolled only inside scan
-bodies) and purely regular memory access — the TPU-friendly answer to
-Pippenger's scatter problem.
+This keeps the optimal ~n adds/window of Pippenger while the whole MSM
+compiles exactly THREE large Jacobian-add bodies regardless of n — XLA
+compile time (the round-1 multichip-gate killer: >8 min for a 16-point
+mesh MSM) is O(1) in both n and the number of reduction phases — and every
+memory access is regular.
 """
 
 from functools import partial
@@ -31,12 +37,30 @@ from jax import lax
 
 from ..constants import FQ_MONT_R, Q_MOD, R_MOD, FR_LIMBS, FQ_LIMBS
 from . import curve_jax as CJ
+from . import field_jax as FJ
+from .field_jax import FR
 from .limbs import ints_to_limbs, limbs_to_int
 from .. import curve as C
 
-NUM_WINDOWS = 32  # 256 bits / 8-bit windows
-WINDOW_BITS = 8
-NUM_BUCKETS = 1 << WINDOW_BITS
+SCALAR_BITS = 256
+
+
+def window_bits(n):
+    """Pippenger window size for an n-point MSM, restricted to divisors of
+    the 16-bit limb width so digit extraction never crosses a limb.
+
+    Standard size-dependent choice (ark picks ~ln n + 2): small inputs get
+    small windows so the O(windows * 2^c) bucket-plane tail does not dwarf
+    the O(n * windows) accumulation — this is also what keeps the tiny-shape
+    multichip dry-run fast, where 8-bit windows would spend minutes adding
+    planes of infinities."""
+    if n >= 4096:
+        return 8
+    if n >= 64:
+        return 4
+    if n >= 8:
+        return 2
+    return 1
 
 
 def _group_size(n):
@@ -46,10 +70,11 @@ def _group_size(n):
     return g
 
 
-def _window_buckets(px, py, pz, digits, group):
-    """One window's bucket sums. px/py/pz: (24, n); digits: (n,) uint32.
+def _bucket_scan(px, py, pz, digits, group, n_buckets):
+    """One window's private-group bucket accumulation.
 
-    Returns bucket points ((24, 256),)*3 with bucket b = sum of points
+    px/py/pz: (24, n); digits: (n,) uint32 < n_buckets. Returns
+    ((24, group, n_buckets),)*3 with group-g bucket b = sum of g's points
     whose digit == b (bucket 0 included but ignored downstream).
     """
     n = px.shape[1]
@@ -66,7 +91,7 @@ def _window_buckets(px, py, pz, digits, group):
     # varying-manual-axes tag; adding a data-derived 0 does exactly that
     # (and constant-folds away otherwise)
     vz = pz.ravel()[0] & 0
-    bx, by, bz = (b + vz for b in CJ.pt_inf((group, NUM_BUCKETS)))
+    bx, by, bz = (b + vz for b in CJ.pt_inf((group, n_buckets)))
 
     def step(carry, x):
         bx, by, bz = carry
@@ -78,46 +103,148 @@ def _window_buckets(px, py, pz, digits, group):
                 bz.at[:, garange, dg].set(nz)), None
 
     (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
+    return bx, by, bz
 
-    # fold the per-group private buckets: scan over groups
-    def red(acc, grp):
-        return CJ.jac_add(acc, grp), None
 
-    acc0 = tuple(b + vz for b in CJ.pt_inf((NUM_BUCKETS,)))
-    grps = tuple(b.transpose(1, 0, 2) for b in (bx, by, bz))  # (group, 24, 256)
-    acc, _ = lax.scan(red, acc0, grps)
+def fold_planes(bx, by, bz):
+    """(K, 24, W, B) bucket planes -> (24, W, B) bucketwise sum.
+
+    Used for both the group fold and the mesh cross-device fold: the scan
+    body is identical in both calls, so XLA compiles it once per program.
+    """
+    vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
+    init = tuple(b + vz for b in CJ.pt_inf(bz.shape[2:]))
+
+    def red(acc, plane):
+        return CJ.jac_add(acc, plane), None
+
+    acc, _ = lax.scan(red, init, (bx, by, bz))
     return acc
 
 
-@jax.jit
-def _finish(bx, by, bz):
-    """(24, 32, 256) window buckets -> total point ((24,),)*3.
+# --- finish tail -------------------------------------------------------------
 
-    Running-sum aggregation (sum_b b*bucket_b, vectorized across windows)
-    then Horner window combine (8 doublings + add per window)."""
-    # scan b = 255 .. 1
-    xs = tuple(b[:, :, 1:][:, :, ::-1].transpose(2, 0, 1) for b in (bx, by, bz))
+def finish(bx, by, bz):
+    """(24, W, B) folded buckets -> total point ((24,),)*3.
 
-    def agg(carry, bucket):
-        run, acc = carry
-        run = CJ.jac_add(run, bucket)
-        acc = CJ.jac_add(acc, run)
-        return (run, acc), None
+    Three phases, all static-shape scans with NO gather/scatter ops (this
+    XLA:CPU build expands scatters into per-index buffer updates, which
+    made an indexed-machine variant of this tail pathologically slow):
 
-    vz = bz.ravel()[0] & 0  # varying-zero, see _window_buckets
-    inf_w = tuple(b + vz for b in CJ.pt_inf((NUM_WINDOWS,)))
-    (_, wsums), _ = lax.scan(agg, (inf_w, inf_w), xs)
+      1. running-sum bucket aggregation: scan over bucket columns B-1..1
+         (+ one infinity flush column), carry (run_w, acc_w) stacked on a
+         lane axis so each step is ONE (24, W, 2) Jacobian add —
+         pipelined:  acc += run ; run += bucket[:, b]  per step.
+      2+3. window weighting and final sum in ONE scan of (shift, mask)
+         steps on (24, W): `shift=0` steps double the masked windows
+         (acc_w ends as 2^(c*w) * A_w), `shift=h` steps add acc[w+h] into
+         acc[w] for w < h (pairwise tree); the total lands in lane 0.
+    """
+    wins, buckets = bz.shape[1], bz.shape[2]
+    c = SCALAR_BITS // wins
+    assert buckets == 1 << c, (wins, buckets)
+    vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
+    inf_w = tuple(x + vz for x in CJ.pt_inf((wins,)))
 
-    # Horner over windows from the top: T = 2^8 T + W_w
-    ws = tuple(w[:, ::-1].transpose(1, 0) for w in wsums)  # (32, 24)
+    # phase 1: bucket columns b = B-1 .. 1, then one infinity flush column
+    def col_xs(a):  # (24, W, B) -> (B, 24, W): columns B-1..1 + inf
+        cols = a[:, :, 1:][:, :, ::-1].transpose(2, 0, 1)
+        return cols
 
-    def comb(total, w):
-        total = lax.fori_loop(0, WINDOW_BITS, lambda i, t: CJ.jac_double(t), total)
-        return CJ.jac_add(total, w), None
+    xs = tuple(jnp.concatenate([col_xs(a), i[None, :, :]], axis=0)
+               for a, i in zip((bx, by, bz), inf_w))
 
-    total0 = tuple(b + vz for b in CJ.pt_inf(()))
-    total, _ = lax.scan(comb, total0, ws)
-    return total
+    def agg(carry, x):
+        # carry: ((24, W, 2),)*3 with lane 0 = run, lane 1 = acc
+        left = tuple(v for v in carry)
+        right = tuple(jnp.stack([xi, v[:, :, 0]], axis=2)
+                      for xi, v in zip(x, left))
+        out = CJ.jac_add(left, right)
+        return out, None
+
+    init = tuple(jnp.stack([i, i], axis=2) for i in inf_w)
+    acc2, _ = lax.scan(agg, init, xs)
+    acc = tuple(v[:, :, 1] for v in acc2)  # (24, W)
+
+    # phase 2+3: doubling ladder + pairwise tree, one (shift, mask) scan
+    steps = []
+    for k in range(c * (wins - 1)):
+        steps.append((0, [k < c * w for w in range(wins)]))
+    h = wins // 2
+    while h >= 1:
+        steps.append((h, [w < h for w in range(wins)]))
+        h //= 2
+    shifts = jnp.asarray(np.array([s for s, _ in steps], dtype=np.int32))
+    masks = jnp.asarray(np.array([m for _, m in steps]))
+
+    def weight(carry, step):
+        shift, mask = step
+        rolled = tuple(jnp.roll(v, -shift, axis=1) for v in carry)
+        summed = CJ.jac_add(carry, rolled)
+        return tuple(jnp.where(mask[None, :], s, v)
+                     for s, v in zip(summed, carry)), None
+
+    acc, _ = lax.scan(weight, acc, (shifts, masks))
+    return tuple(v[:, 0] for v in acc)
+
+
+def msm_pipeline(px, py, pz, digits, group):
+    """Full single-device MSM: points (24, n) + digits (W, n) -> total."""
+    buckets = 1 << (SCALAR_BITS // digits.shape[0])
+    wb = jax.vmap(partial(_bucket_scan, group=group, n_buckets=buckets),
+                  in_axes=(None, None, None, 0))(px, py, pz, digits)
+    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)  # (G, 24, W, B)
+    acc = fold_planes(*planes)
+    return finish(*acc)
+
+
+def digits_from_mont(v, c, padded_n):
+    """(16, L) Montgomery Fr coefficients -> (256/c, padded_n) uint32
+    digits, entirely on device (no host round-trip before a commitment)."""
+    canon = FJ.from_mont(FR, v)
+    if canon.shape[1] < padded_n:
+        canon = jnp.pad(canon, ((0, 0), (0, padded_n - canon.shape[1])))
+    per_limb = 16 // c
+    mask = (1 << c) - 1
+    parts = [(canon >> (c * i)) & mask for i in range(per_limb)]
+    return jnp.stack(parts, axis=1).reshape(SCALAR_BITS // c, padded_n)
+
+
+def digits_of_scalars(scalars, padded_n, c):
+    """Host int scalars -> (256/c, padded_n) uint32 radix-2^c digits.
+
+    c must divide 16 so every window lives inside one 16-bit limb."""
+    assert 16 % c == 0
+    scalars = [s % R_MOD for s in scalars]
+    scalars += [0] * (padded_n - len(scalars))
+    limbs = ints_to_limbs(scalars, FR_LIMBS)  # (16, n)
+    per_limb = 16 // c
+    mask = (1 << c) - 1
+    parts = [(limbs >> (c * i)) & mask for i in range(per_limb)]
+    # window order: limb0's sub-digits (low->high), then limb1's, ...
+    digits = np.stack(parts, axis=1).astype(np.uint32)
+    return digits.reshape(SCALAR_BITS // c, padded_n)
+
+
+def points_to_device(bases_affine, pad):
+    """list[(x, y) | None] + pad count -> Jacobian (24, n+pad) Montgomery."""
+    xs, ys, infs = [], [], []
+    for p in bases_affine:
+        if p is None:
+            xs.append(0)
+            ys.append(0)
+            infs.append(True)
+        else:
+            xs.append(p[0] * FQ_MONT_R % Q_MOD)
+            ys.append(p[1] * FQ_MONT_R % Q_MOD)
+            infs.append(False)
+    xs += [0] * pad
+    ys += [0] * pad
+    infs += [True] * pad
+    x = jnp.asarray(ints_to_limbs(xs, FQ_LIMBS))
+    y = jnp.asarray(ints_to_limbs(ys, FQ_LIMBS))
+    inf = jnp.asarray(np.array(infs))
+    return CJ.from_affine(x, y, inf)
 
 
 class MsmContext:
@@ -130,41 +257,28 @@ class MsmContext:
         pad = n % 2  # groups need >= 2 scan steps
         self.padded_n = n + pad
         self.group = _group_size(self.padded_n)
-        # one program: all 32 windows' bucket accumulations vmapped together
-        self._windows_fn = jax.jit(jax.vmap(
-            partial(_window_buckets, group=self.group),
-            in_axes=(None, None, None, 0)))
-        xs, ys, infs = [], [], []
-        for p in bases_affine:
-            if p is None:
-                xs.append(0)
-                ys.append(0)
-                infs.append(True)
-            else:
-                xs.append(p[0] * FQ_MONT_R % Q_MOD)
-                ys.append(p[1] * FQ_MONT_R % Q_MOD)
-                infs.append(False)
-        xs += [0] * pad
-        ys += [0] * pad
-        infs += [True] * pad
-        x = jnp.asarray(ints_to_limbs(xs, FQ_LIMBS))
-        y = jnp.asarray(ints_to_limbs(ys, FQ_LIMBS))
-        inf = jnp.asarray(np.array(infs))
-        self.point = CJ.from_affine(x, y, inf)
+        self.c = window_bits(self.padded_n)
+        self._fn = jax.jit(partial(msm_pipeline, group=self.group))
+        self._digits_fn = jax.jit(
+            partial(digits_from_mont, c=self.c, padded_n=self.padded_n))
+        self.point = points_to_device(bases_affine, pad)
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
         assert len(scalars) <= self.n
-        scalars = [s % R_MOD for s in scalars]
-        scalars += [0] * (self.padded_n - len(scalars))
-        limbs = jnp.asarray(ints_to_limbs(scalars, FR_LIMBS))  # (16, n)
-        digits = jnp.stack([limbs & 0xFF, limbs >> 8], axis=1)
-        digits = digits.reshape(NUM_WINDOWS, self.padded_n)
-
+        digits = digits_of_scalars(scalars, self.padded_n, self.c)
         px, py, pz = self.point
-        wb = self._windows_fn(px, py, pz, digits)  # ((32, 24, 256),)*3
-        bx, by, bz = (b.transpose(1, 0, 2) for b in wb)
-        tx, ty, tz = _finish(bx, by, bz)
+        tx, ty, tz = self._fn(px, py, pz, digits)
+        return _jac_limbs_to_affine(tx, ty, tz)
+
+    def msm_mont_limbs(self, h):
+        """Commit a (16, L <= padded_n) Montgomery Fr coefficient handle:
+        digit extraction happens on device; only the resulting group
+        element returns to the host (for the transcript)."""
+        assert h.shape[1] <= self.n, (h.shape, self.n)
+        digits = self._digits_fn(h)
+        px, py, pz = self.point
+        tx, ty, tz = self._fn(px, py, pz, digits)
         return _jac_limbs_to_affine(tx, ty, tz)
 
 

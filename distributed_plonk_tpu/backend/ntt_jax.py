@@ -6,13 +6,18 @@ with optional coset pre/post scaling by the Fr multiplicative generator g=7.
 Semantics are bit-identical to the host oracle in poly.py.
 
 Design notes (TPU-first):
-- One vectorized butterfly per stage: the whole stage is a single reshaped
-  (16, blocks, 2, half) Montgomery multiply + add/sub, so the traced op
-  count is O(log n), independent of n, and XLA sees large fusible
-  elementwise ops that map onto the VPU.
-- Twiddles are precomputed incremental tables in Montgomery form (the
-  reference recomputes g.pow per element on the hot path,
-  src/worker.rs:77-79,91-93 — a known inefficiency we do not copy).
+- Constant-geometry (Pease) dataflow: EVERY stage is the same program —
+  butterfly the two array halves (i, i+n/2) and interleave the outputs —
+  so all log2(n) stages run as ONE `lax.scan` body and the traced/compiled
+  program size is O(1) in n (the round-1 version unrolled log2(n) distinct
+  reshaped stages and paid tens of seconds of XLA compile per domain).
+  Input is natural order; one bit-reversal gather at the output.
+  Stage-s twiddle for pair p is w^e with e = bitrev_s(p mod 2^s)·2^(k-1-s),
+  verified bit-identical to the oracle's iterative DIT for all modes.
+- Twiddles are looked up per stage from ONE Montgomery power table
+  w^0..w^(n-1) via a precomputed (log n, n/2) exponent matrix — the
+  reference recomputes g.pow per element on the hot path
+  (src/worker.rs:77-79,91-93 — a known inefficiency we do not copy).
 - The iNTT 1/n scale and the inverse-coset g^-i scale are fused into one
   table multiply.
 """
@@ -20,6 +25,7 @@ Design notes (TPU-first):
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..constants import R_MOD, FR_GENERATOR, FR_LIMBS, FR_MONT_R
 from ..fields import fr_inv, fr_root_of_unity
@@ -40,28 +46,57 @@ def _powers(base, count, start=1):
     return out
 
 
-def batched_butterflies(v, perm, tables):
-    """Radix-2 DIT butterflies on a batch of rows.
+def _bitrev_perm(n):
+    log_n = n.bit_length() - 1
+    idx = np.arange(n, dtype=np.int64)
+    rev = np.zeros(n, dtype=np.int64)
+    for s in range(log_n):
+        rev |= ((idx >> s) & 1) << (log_n - 1 - s)
+    return rev.astype(np.int32)
 
-    v: (16, B, n) Montgomery limbs; perm: (n,) bit-reversal index;
-    tables: per-stage (16, m) Montgomery twiddles. Shared by the
-    single-device kernel and the mesh 4-step NTT's row/column stages.
+
+def _stage_exponents(n):
+    """(log n, n/2) int32: exponent of w_n for stage s, pair p —
+    e(s, p) = bitrev_s(p mod 2^s) * 2^(k-1-s)."""
+    k = n.bit_length() - 1
+    p = np.arange(n // 2, dtype=np.int64)
+    exps = np.zeros((max(k, 1), max(n // 2, 1)), dtype=np.int64)
+    for s in range(k):
+        low = p & ((1 << s) - 1)
+        rev = np.zeros_like(low)
+        for b in range(s):
+            rev |= ((low >> b) & 1) << (s - 1 - b)
+        exps[s] = rev << (k - 1 - s)
+    return exps[:k, : n // 2].astype(np.int32)
+
+
+def batched_butterflies(v, perm, exps, pow_tab):
+    """Constant-geometry radix-2 NTT core on a batch of rows.
+
+    v: (16, B, n) Montgomery limbs in NATURAL order; perm: (n,) bit-reversal
+    gather applied at the OUTPUT; exps: (log n, n/2) int32 stage exponents;
+    pow_tab: (16, n) Montgomery powers of the (inverse) root of unity.
+    Returns the (i)NTT in natural order (1/n scaling NOT included).
+    Shared by the single-device kernel and the mesh 4-step NTT stages.
     """
     n = v.shape[2]
     if n == 1:
         return v
     b = v.shape[1]
-    v = v[:, :, perm]
-    for tw in tables:
-        m = tw.shape[1]
-        blocks = n // (2 * m)
-        v = v.reshape(FR_LIMBS, b, blocks, 2, m)
-        u = v[:, :, :, 0, :]
-        t = v[:, :, :, 1, :]
-        t = FJ.mont_mul(FR, t, tw[:, None, None, :])
-        v = jnp.stack([FJ.add(FR, u, t), FJ.sub(FR, u, t)], axis=3)
-        v = v.reshape(FR_LIMBS, b, n)
-    return v
+    half = n // 2
+
+    def stage(carry, e):
+        u = carry[:, :, :half]
+        t = carry[:, :, half:]
+        tw = pow_tab[:, e]  # (16, n/2) gathered stage twiddles
+        t = FJ.mont_mul(FR, t, tw[:, None, :])
+        hi = FJ.add(FR, u, t)
+        lo = FJ.sub(FR, u, t)
+        out = jnp.stack([hi, lo], axis=3)  # interleave: out[2p], out[2p+1]
+        return out.reshape(FR_LIMBS, b, n), None
+
+    v, _ = lax.scan(stage, v, exps)
+    return v[:, :, perm]
 
 
 class NttPlan:
@@ -74,21 +109,10 @@ class NttPlan:
         w = fr_root_of_unity(n)
         w_inv = fr_inv(w) if n > 1 else 1
 
-        idx = np.arange(n, dtype=np.int64)
-        rev = np.zeros(n, dtype=np.int64)
-        for s in range(self.log_n):
-            rev |= ((idx >> s) & 1) << (self.log_n - 1 - s)
-        self.perm = rev.astype(np.int32)
-
-        self.tw_fwd = []
-        self.tw_inv = []
-        m = 1
-        while m < n:
-            wm = pow(w, n // (2 * m), R_MOD)
-            wmi = pow(w_inv, n // (2 * m), R_MOD)
-            self.tw_fwd.append(_mont_table(_powers(wm, m)))
-            self.tw_inv.append(_mont_table(_powers(wmi, m)))
-            m <<= 1
+        self.perm = _bitrev_perm(n)
+        self.exps = _stage_exponents(n)
+        self.pow_fwd = _mont_table(_powers(w, max(n, 1)))
+        self.pow_inv = _mont_table(_powers(w_inv, max(n, 1)))
 
         g = FR_GENERATOR
         n_inv = fr_inv(n % R_MOD)
@@ -105,9 +129,9 @@ class NttPlan:
         pipelines). boundary="plain": canonical-form input/output (host
         round-trips); conversion is fused into the same XLA program.
 
-        The O(n) tables (permutation, twiddles, coset scales) are passed as
-        traced arguments, not baked-in constants, so compiled programs and
-        persistent-cache entries stay small.
+        The O(n) tables (permutation, exponents, power table, coset scales)
+        are passed as traced arguments, not baked-in constants, so compiled
+        programs and persistent-cache entries stay small.
         """
         key = (inverse, coset, boundary)
         if key not in self._fns:
@@ -115,8 +139,8 @@ class NttPlan:
             plain = boundary == "plain"
             consts = {
                 "perm": jnp.asarray(self.perm),
-                "tables": tuple(jnp.asarray(t) for t in
-                                (self.tw_inv if inverse else self.tw_fwd)),
+                "exps": jnp.asarray(self.exps),
+                "pow": jnp.asarray(self.pow_inv if inverse else self.pow_fwd),
             }
             if coset and not inverse:
                 consts["pre"] = jnp.asarray(self.coset_tab)
@@ -131,7 +155,8 @@ class NttPlan:
                 if "pre" in consts:
                     v = FJ.mont_mul(FR, v, consts["pre"])
                 v = batched_butterflies(
-                    v[:, None, :], consts["perm"], consts["tables"])[:, 0, :]
+                    v[:, None, :], consts["perm"], consts["exps"],
+                    consts["pow"])[:, 0, :]
                 if "post" in consts:
                     post = consts["post"]
                     if post.shape[1] == 1:  # plain 1/n: broadcast symbolically

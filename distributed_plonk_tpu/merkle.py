@@ -7,9 +7,11 @@ height-32 tree and pulls per-element membership proofs;
 MerkleTreeGadget). Same shape: branching factor 3 (the Rescue rate), sparse
 tree addressed by u64 leaf index, leaf digest = H(index, payload, tag).
 
-The in-circuit path verifier costs ~157 gates per level (148 for the
-permutation + 9 for position selection), matching the reference's stated
-cost model `num_proofs * (157*height + 149)`
+The in-circuit path verifier costs ~159 gates per level (148 for the
+permutation + 11 for position selection: 3 enforce_bool + one-hot lc +
+enforce_equal + 6 in _select3, the same count workload.py's cost model
+uses), matching the order of the reference's stated cost model
+`num_proofs * (157*height + 149)`
 (/root/reference/src/dispatcher.rs:1068-1070).
 """
 

@@ -5,17 +5,21 @@ TPU-native replacement for the reference's distributed MSM
 bases and scalars are range-sharded across the mesh (the MsmWorkload
 convention, with the v1 full-coverage semantics — SURVEY.md §2.3.1),
 every device runs the sort-free Pippenger bucket pipeline on its slice,
-and the partial G1 sums fold ON DEVICE via all_gather + a tiny scan —
-replacing the reference's host-side sum-reduce (dispatcher2.rs:888-890).
-(G1 addition is not a ring sum, so `psum` does not apply; the
-all_gather+fold is the collective equivalent.)
+and the per-device BUCKET PLANES fold ON DEVICE via all_gather + the same
+scanned fold body the group fold uses — replacing the reference's
+host-side sum-reduce of partial totals (dispatcher2.rs:888-890). (G1
+addition is not a ring sum, so `psum` does not apply; the all_gather+fold
+is the collective equivalent.) A single finish machine then turns the
+globally folded buckets into the result, so the whole mesh program
+compiles the same THREE Jacobian-add bodies as the single-device path —
+the structure that keeps the multi-chip dry-run inside the compile budget
+on a virtual CPU mesh.
 """
 
 from functools import partial
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -24,10 +28,7 @@ try:
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from ..constants import FQ_MONT_R, Q_MOD, R_MOD, FR_LIMBS, FQ_LIMBS
-from ..backend import curve_jax as CJ
 from ..backend import msm_jax
-from ..backend.limbs import ints_to_limbs
 from .mesh import SHARD_AXIS
 
 
@@ -46,64 +47,51 @@ class MeshMsmContext:
         self.padded_n = n + pad
         self.local_n = self.padded_n // d
         self.group = msm_jax._group_size(self.local_n)
+        # Pippenger window size from the per-device slice (what each
+        # device's bucket pipeline actually sees)
+        self.c = msm_jax.window_bits(self.local_n)
 
-        xs, ys, infs = [], [], []
-        for p in bases_affine:
-            if p is None:
-                xs.append(0)
-                ys.append(0)
-                infs.append(True)
-            else:
-                xs.append(p[0] * FQ_MONT_R % Q_MOD)
-                ys.append(p[1] * FQ_MONT_R % Q_MOD)
-                infs.append(False)
-        xs += [0] * pad
-        ys += [0] * pad
-        infs += [True] * pad
+        point = msm_jax.points_to_device(bases_affine, pad)
         shard_nd = jax.sharding.NamedSharding(mesh, P(None, SHARD_AXIS))
-        x = jax.device_put(ints_to_limbs(xs, FQ_LIMBS), shard_nd)
-        y = jax.device_put(ints_to_limbs(ys, FQ_LIMBS), shard_nd)
-        inf = jax.device_put(np.array(infs), jax.sharding.NamedSharding(mesh, P(SHARD_AXIS)))
-        self.point = jax.jit(CJ.from_affine)(x, y, inf)
+        self.point = tuple(jax.device_put(c, shard_nd) for c in point)
 
         shard = P(None, SHARD_AXIS)
-        digit_spec = P(None, SHARD_AXIS)
 
         def body(px, py, pz, digits):
-            # local slice: (24, local_n); digits (32, local_n)
-            wb = jax.vmap(partial(msm_jax._window_buckets, group=self.group),
+            # local slice: (24, local_n); digits (W, local_n)
+            wb = jax.vmap(partial(msm_jax._bucket_scan, group=self.group,
+                                  n_buckets=1 << self.c),
                           in_axes=(None, None, None, 0))(px, py, pz, digits)
-            bx, by, bz = (b.transpose(1, 0, 2) for b in wb)
-            tx, ty, tz = msm_jax._finish(bx, by, bz)
-            # fold the D partial sums on device (reference folds on the
-            # dispatcher host instead)
-            gx = lax.all_gather(tx, SHARD_AXIS)  # (D, 24)
-            gy = lax.all_gather(ty, SHARD_AXIS)
-            gz = lax.all_gather(tz, SHARD_AXIS)
-
-            def red(acc, g):
-                return CJ.jac_add(acc, g), None
-
-            vz = gz.ravel()[0] & 0  # varying-zero, see msm_jax._window_buckets
-            init = tuple(b + vz for b in CJ.pt_inf(()))
-            total, _ = lax.scan(red, init, (gx, gy, gz))
-            return total
+            planes = tuple(b.transpose(2, 1, 0, 3) for b in wb)
+            local = msm_jax.fold_planes(*planes)  # (24, 32, 256) per device
+            # fold bucket planes across the mesh on device (the reference
+            # folds partial totals on the dispatcher host instead); the
+            # fold body is identical to the group fold's -> compiled once
+            gathered = tuple(lax.all_gather(b, SHARD_AXIS) for b in local)
+            return msm_jax.fold_planes(*gathered)
 
         # check_vma=False: the all_gather+fold makes the outputs replicated
         # in value, which the varying-axes checker cannot infer statically
         self._fn = jax.jit(_shard_map(
             body, mesh=mesh,
-            in_specs=(shard, shard, shard, digit_spec),
-            out_specs=(P(None), P(None), P(None)), check_vma=False))
+            in_specs=(shard, shard, shard, shard),
+            out_specs=(P(None, None, None),) * 3, check_vma=False))
+        # the O(windows*buckets) finish tail runs on the replicated fold
+        # result OUTSIDE the mesh program: one single-device compile (shared
+        # with MsmContext's pipeline via the persistent cache) instead of an
+        # 8-partition one
+        self._finish = jax.jit(msm_jax.finish)
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
         assert len(scalars) <= self.n
-        scalars = [s % R_MOD for s in scalars]
-        scalars += [0] * (self.padded_n - len(scalars))
-        limbs = ints_to_limbs(scalars, FR_LIMBS)
-        digits = np.stack([limbs & 0xFF, limbs >> 8], axis=1).astype(np.uint32)
-        digits = digits.reshape(msm_jax.NUM_WINDOWS, self.padded_n)
+        digits = msm_jax.digits_of_scalars(scalars, self.padded_n, self.c)
         px, py, pz = self.point
-        tx, ty, tz = self._fn(px, py, pz, digits)
+        buckets = self._fn(px, py, pz, digits)
+        # commit the replicated fold result to ONE device: otherwise the
+        # finish jit inherits the 8-way replicated sharding and every
+        # device redundantly executes the whole tail
+        dev = self.mesh.devices.ravel()[0]
+        buckets = tuple(jax.device_put(b, dev) for b in buckets)
+        tx, ty, tz = self._finish(*buckets)
         return msm_jax._jac_limbs_to_affine(tx, ty, tz)

@@ -116,9 +116,11 @@ class MeshNttPlan:
         # may not be the process default backend, e.g. cpu mesh + tpu default)
         consts = {
             "perm_r": self.plan_r.perm,
-            "tabs_r": tuple(self.plan_r.tw_inv if inverse else self.plan_r.tw_fwd),
+            "exps_r": self.plan_r.exps,
+            "pow_r": self.plan_r.pow_inv if inverse else self.plan_r.pow_fwd,
             "perm_c": self.plan_c.perm,
-            "tabs_c": tuple(self.plan_c.tw_inv if inverse else self.plan_c.tw_fwd),
+            "exps_c": self.plan_c.exps,
+            "pow_c": self.plan_c.pow_inv if inverse else self.plan_c.pow_fwd,
             "mid": self.mid_inv if inverse else self.mid_fwd,
         }
         if coset and not inverse:
@@ -128,8 +130,8 @@ class MeshNttPlan:
 
         row_spec = P(None, SHARD_AXIS, None)
         const_specs = {
-            "perm_r": P(None), "tabs_r": tuple(P(None, None) for _ in consts["tabs_r"]),
-            "perm_c": P(None), "tabs_c": tuple(P(None, None) for _ in consts["tabs_c"]),
+            "perm_r": P(None), "exps_r": P(None, None), "pow_r": P(None, None),
+            "perm_c": P(None), "exps_c": P(None, None), "pow_c": P(None, None),
             "mid": row_spec,
         }
         if "pre" in consts:
@@ -142,13 +144,15 @@ class MeshNttPlan:
             # a: (16, c/d, r) local rows of A
             if "pre" in cs:
                 a = FJ.mont_mul(FR, a, cs["pre"])
-            v = ntt_jax.batched_butterflies(a, cs["perm_r"], cs["tabs_r"])
+            v = ntt_jax.batched_butterflies(
+                a, cs["perm_r"], cs["exps_r"], cs["pow_r"])
             v = FJ.mont_mul(FR, v, cs["mid"])
             # the ONE inter-stage transpose: (16, c/d, r) -> (16, c, r/d)
             v = lax.all_to_all(v, SHARD_AXIS, split_axis=2, concat_axis=1,
                                tiled=True)
             v = v.swapaxes(1, 2)  # local transpose -> (16, r/d, c)
-            v = ntt_jax.batched_butterflies(v, cs["perm_c"], cs["tabs_c"])
+            v = ntt_jax.batched_butterflies(
+                v, cs["perm_c"], cs["exps_c"], cs["pow_c"])
             if "post" in cs:
                 post = cs["post"]
                 if post.ndim == 2:  # plain 1/n scalar, broadcast symbolically

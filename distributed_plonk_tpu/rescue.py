@@ -52,12 +52,17 @@ def _derive_mds():
     """4x4 Cauchy matrix M[i][j] = 1/(x_i + y_j): MDS whenever the x_i and
     y_j are distinct and all sums nonzero (every square submatrix of a
     Cauchy matrix is invertible)."""
+    attempt = 0
     while True:
-        elems = _shake_field_elements("dpt-rescue-mds-v1", 2 * STATE_WIDTH)
+        # attempt counter in the tag: every retry draws fresh elements
+        # (a fixed tag would loop forever if the first draw ever failed)
+        elems = _shake_field_elements(
+            f"dpt-rescue-mds-v1-{attempt}", 2 * STATE_WIDTH)
         xs, ys = elems[:STATE_WIDTH], elems[STATE_WIDTH:]
         if len(set(xs)) == STATE_WIDTH and len(set(ys)) == STATE_WIDTH and all(
                 (x + y) % R_MOD != 0 for x in xs for y in ys):
             break
+        attempt += 1
     return [[pow((x + y) % R_MOD, -1, R_MOD) for y in ys] for x in xs]
 
 
@@ -94,12 +99,18 @@ def hash3(a, b, c):
     return permutation([a % R_MOD, b % R_MOD, c % R_MOD, 0])[0]
 
 
+_SPONGE_IV = 2  # capacity-element IV: domain-separates the variable-length
+# sponge from hash3 (capacity 0), so sponge([a,b]) can never collide with a
+# fixed-length digest like leaf/node hashes
+
+
 def sponge(inputs):
-    """Variable-length sponge (rate 3, 10* zero-padding to a rate multiple)."""
+    """Variable-length sponge (rate 3, 10* zero-padding to a rate multiple,
+    nonzero capacity IV for domain separation from hash3)."""
     data = [x % R_MOD for x in inputs] + [1]
     while len(data) % RATE:
         data.append(0)
-    state = [0] * STATE_WIDTH
+    state = [0] * RATE + [_SPONGE_IV]
     for off in range(0, len(data), RATE):
         for i in range(RATE):
             state[i] = (state[i] + data[off + i]) % R_MOD

@@ -14,6 +14,7 @@ import threading
 
 from . import native, protocol
 from .. import curve as C
+from ..backend.python_backend import PythonBackend
 
 
 class WorkerHandle:
@@ -103,9 +104,12 @@ class Dispatcher:
             w.close()
 
 
-class RemoteBackend:
+class RemoteBackend(PythonBackend):
     """Prover backend that routes every FFT/MSM through the worker fleet —
-    the v2 fully-distributed prove path (reference dispatcher2.rs:192-713)."""
+    the v2 fully-distributed prove path (reference dispatcher2.rs:192-713).
+    The poly-handle protocol (round math) is inherited from the host
+    oracle: like the reference's dispatcher, the sequential round logic
+    stays local while the throughput kernels go to the fleet."""
 
     name = "remote"
 

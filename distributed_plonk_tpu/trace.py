@@ -1,0 +1,71 @@
+"""Structured per-round / per-collective tracing.
+
+The structured upgrade of the reference's ad-hoc timing printouts
+(`println!("Elapsed: {:.2?}")` around each prover round,
+/root/reference/src/dispatcher.rs:625,645,678,806,827,942 — commented out
+in v2, dispatcher2.rs:293-693): spans are recorded as events with
+wall-clock durations and emitted as JSON, so the driver/bench can consume
+per-round numbers instead of scraping stdout.
+
+Usage:
+    tracer = Tracer()
+    with tracer.span("round1"):
+        with tracer.span("round1/ifft", polys=5):
+            ...
+    print(tracer.to_json())
+"""
+
+import json
+import time
+from contextlib import contextmanager
+
+
+class Tracer:
+    def __init__(self):
+        self.events = []
+        self._stack = []
+
+    @contextmanager
+    def span(self, name, **attrs):
+        path = "/".join(s for s in self._stack + [name])
+        self._stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._stack.pop()
+            ev = {"span": path, "dur_s": round(dur, 6)}
+            if attrs:
+                ev.update(attrs)
+            self.events.append(ev)
+
+    def totals(self, depth=1):
+        """{span: total seconds} for spans at most `depth` levels deep."""
+        out = {}
+        for ev in self.events:
+            if ev["span"].count("/") < depth:
+                out[ev["span"]] = out.get(ev["span"], 0.0) + ev["dur_s"]
+        return out
+
+    def to_json(self):
+        return json.dumps({"events": self.events}, separators=(",", ":"))
+
+
+class _NullTracer:
+    """No-op tracer: `span` costs one contextmanager enter/exit."""
+
+    events = ()
+
+    @contextmanager
+    def span(self, name, **attrs):
+        yield
+
+    def totals(self, depth=1):
+        return {}
+
+    def to_json(self):
+        return "{}"
+
+
+NULL_TRACER = _NullTracer()

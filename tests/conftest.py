@@ -20,6 +20,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 # The persistent compilation cache itself is configured by
 # distributed_plonk_tpu.backend.field_jax at import time.
 
+# NOTE: a site-installed TPU plugin (axon) may override JAX_PLATFORMS at
+# interpreter startup, in which case single-device tests run on the real
+# chip (with its remote-compile service) — that is deliberate extra
+# coverage of the TPU lowering. The mesh tests pin platform="cpu"
+# explicitly, so the 8-device virtual mesh is exercised either way.
+
 import pytest
 
 

@@ -15,8 +15,16 @@ from distributed_plonk_tpu.backend.jax_backend import JaxBackend
 
 def test_jax_prove_verifies_and_matches_oracle(proven):
     ckt, pk, vk, proof_host = proven
-    proof_dev = prove(random.Random(1), ckt, pk, JaxBackend())
+    be = JaxBackend()
+    proof_dev = prove(random.Random(1), ckt, pk, be)
     assert verify(vk, ckt.public_input(), proof_dev, rng=random.Random(2))
+
+    # device residency: O(n) host->device uploads are the proving key, the
+    # circuit witness/permutation tables (once each, cached) and the
+    # public-input vector; the only lowers are the 10 round-4 evaluations
+    # (everything else stays on device between rounds)
+    assert be.lifts == 3, be.lifts
+    assert be.lowers == 10, be.lowers
 
     # bit-identical across backends (the reference's core invariant:
     # distributed == single-node, SURVEY.md §4)

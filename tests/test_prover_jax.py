@@ -1,0 +1,117 @@
+"""Device prover-kernel tests: bit-identical to the host oracle."""
+
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_plonk_tpu.constants import R_MOD, FR_GENERATOR
+from distributed_plonk_tpu import poly as P
+from distributed_plonk_tpu.fields import fr_inv, batch_inverse
+from distributed_plonk_tpu.backend import prover_jax as PJ
+
+rng = random.Random(11)
+
+
+def rand_vals(n):
+    return [rng.randrange(R_MOD) for _ in range(n)]
+
+
+def test_lift_lower_roundtrip():
+    vals = rand_vals(17)
+    assert PJ.lower(jnp.asarray(PJ.lift(vals))) == vals
+
+
+def test_cumprod_matches_host():
+    vals = rand_vals(33)
+    got = PJ.lower(jax.jit(PJ.cumprod)(jnp.asarray(PJ.lift(vals))))
+    acc, want = 1, []
+    for v in vals:
+        acc = acc * v % R_MOD
+        want.append(acc)
+    assert got == want
+
+
+def test_fr_pow_matches_host():
+    vals = rand_vals(5)
+    for e in (1, 2, 5, R_MOD - 2, 1 << 20):
+        got = PJ.lower(jax.jit(PJ.fr_pow, static_argnums=1)(jnp.asarray(PJ.lift(vals)), e))
+        assert got == [pow(v, e, R_MOD) for v in vals], e
+
+
+def test_batch_inverse_matches_host():
+    vals = rand_vals(50)
+    got = PJ.lower(jax.jit(PJ.batch_inverse)(jnp.asarray(PJ.lift(vals))))
+    assert got == batch_inverse(vals, R_MOD)
+
+
+def test_poly_eval_matches_host():
+    for n in (1, 7, 300, 1030):
+        poly = rand_vals(n)
+        z = rng.randrange(R_MOD)
+        zc = jnp.asarray(PJ.lift_scalar(z))
+        got = PJ.lower(PJ.poly_eval_jit(jnp.asarray(PJ.lift(poly)), zc))
+        assert got == [P.poly_eval(poly, z)], n
+
+
+def test_synthetic_divide_matches_host():
+    for n in (2, 9, 257):
+        poly = rand_vals(n)
+        z = rng.randrange(1, R_MOD)
+        zc = jnp.asarray(PJ.lift_scalar(z))
+        got = PJ.lower(PJ.synthetic_divide_jit(jnp.asarray(PJ.lift(poly)), zc))
+        assert got == P.synthetic_divide(poly, z), n
+
+
+def test_lin_comb_matches_host():
+    polys = [rand_vals(5), rand_vals(9), rand_vals(3)]
+    coeffs = rand_vals(3)
+    L = max(len(p) for p in polys)
+    stacked = jnp.stack([jnp.pad(jnp.asarray(PJ.lift(p)), ((0, 0), (0, L - len(p))))
+                         for p in polys], axis=1)
+    cf = jnp.asarray(PJ.lift(coeffs)).reshape(16, len(coeffs), 1)
+    got = PJ.lower(PJ.lin_comb_jit(stacked, cf))
+    want = []
+    for p, c in zip(polys, coeffs):
+        want = P.poly_add(want, P.poly_scale(p, c))
+    want += [0] * (9 - len(want))
+    assert got == want
+
+
+def test_add_vanishing_blind_matches_host():
+    n = 16
+    coeffs = rand_vals(n)
+    blinds = rand_vals(3)
+    got = PJ.lower(PJ.blind_jit(jnp.asarray(PJ.lift(coeffs)),
+                                jnp.asarray(PJ.lift(blinds)), n))
+    want = P.poly_add(P.poly_mul_vanishing(blinds, n), coeffs)
+    assert got == want
+
+
+def test_tail_is_zero():
+    poly = rand_vals(6) + [0, 0]
+    h = jnp.asarray(PJ.lift(poly))
+    assert PJ.tail_is_zero(h, 5)
+    assert not PJ.tail_is_zero(h, 4)
+
+
+def test_domain_tables_match_host():
+    n, m = 8, 32
+    dom = P.Domain(m)
+    g = FR_GENERATOR
+    tabs = PJ.domain_tables_jit(m, n, g, dom.group_gen)
+    ep = PJ.lower(tabs["ep"])
+    want_ep = []
+    cur = g
+    for _ in range(m):
+        want_ep.append(cur)
+        cur = cur * dom.group_gen % R_MOD
+    assert ep == want_ep
+    ratio = m // n
+    zh_inv = PJ.lower(tabs["zh_inv"])
+    assert zh_inv == [fr_inv((pow(want_ep[i % ratio], n, R_MOD) - 1) % R_MOD)
+                      for i in range(m)]
+    shifted_inv = PJ.lower(tabs["shifted_inv"])
+    assert shifted_inv == [fr_inv((e - 1) % R_MOD) for e in want_ep]

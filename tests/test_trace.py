@@ -1,0 +1,44 @@
+"""Tracer unit tests + per-round prove instrumentation."""
+
+import json
+
+from distributed_plonk_tpu.trace import Tracer, NULL_TRACER
+
+
+def test_tracer_spans_nest_and_total():
+    tr = Tracer()
+    with tr.span("round1"):
+        with tr.span("ifft", polys=5):
+            pass
+    with tr.span("round2"):
+        pass
+    spans = [e["span"] for e in tr.events]
+    assert spans == ["round1/ifft", "round1", "round2"]
+    assert tr.events[0]["polys"] == 5
+    tot = tr.totals(depth=1)
+    assert set(tot) == {"round1", "round2"}
+    data = json.loads(tr.to_json())
+    assert len(data["events"]) == 3
+
+
+def test_null_tracer_noop():
+    with NULL_TRACER.span("x"):
+        pass
+    assert NULL_TRACER.totals() == {}
+
+
+def test_prove_emits_round_spans(proven):
+    import random
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+
+    ckt, pk, vk, proof = proven
+    tr = Tracer()
+    proof2 = prove(random.Random(1), ckt, pk, PythonBackend(), tracer=tr)
+    # same rng seed => identical proof; tracing must not perturb the prover
+    assert proof2.wires_poly_comms == proof.wires_poly_comms
+    tot = tr.totals(depth=1)
+    assert set(tot) == {"round1", "round2", "round3", "round4", "round5"}
+    assert all(v >= 0 for v in tot.values())
+    sub = [e["span"] for e in tr.events]
+    assert "round3/quotient_evals" in sub and "round1/commit_wires" in sub
