@@ -1,0 +1,103 @@
+"""Fixed-base batch scalar multiplication on device: the SRS generator.
+
+The reference gets its SRS from jf-plonk's `universal_setup`
+(/root/reference/src/dispatcher2.rs:1279), a serial fixed-base walk
+[tau^0]G, [tau^1]G, ... on the host. That walk is the scale blocker for
+reference-size domains (2^18 powers = 2^18 sequential scalar muls), so here
+it becomes one device program: a windowed fixed-base table is precomputed
+once on the host (the base is a single public generator — 32 windows x 256
+multiples, ~8k cheap host adds), and the batch [s_i]G for all N scalars is
+a lax.scan over the 32 windows whose body gathers each scalar's digit row
+from the table and performs ONE vectorized Jacobian add across the whole
+batch. Like the MSM pipeline (msm_jax.py), the traced program contains a
+single jac_add instance, so compile time is O(1) in N.
+
+The result stays on device as Jacobian Montgomery limb arrays and feeds the
+MSM directly (MsmContext.from_jacobian) — the commit key never needs to be
+normalized to affine on the host for the prover path.
+"""
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import FQ_MONT_R, Q_MOD, FQ_LIMBS
+from .. import curve as C
+from . import curve_jax as CJ
+from .limbs import ints_to_limbs
+from .msm_jax import SCALAR_BITS, digits_of_scalars
+
+WINDOW_BITS = 8
+N_WINDOWS = SCALAR_BITS // WINDOW_BITS  # 32
+N_BUCKETS = 1 << WINDOW_BITS  # 256
+
+
+def _host_window_table(base_affine):
+    """(N_WINDOWS, N_BUCKETS) table of d * 2^(8w) * base as host Jacobian
+    int tuples; table[w][0] is the point at infinity."""
+    inf = (1, 1, 0)
+    table = []
+    b = C.g1_to_jac(base_affine)
+    for _ in range(N_WINDOWS):
+        row = [inf]
+        acc = inf
+        for _ in range(N_BUCKETS - 1):
+            acc = C.g1_jac_add(acc, b)
+            row.append(acc)
+        table.append(row)
+        for _ in range(WINDOW_BITS):
+            b = C.g1_jac_double(b)
+    return table
+
+
+def _table_to_device(table):
+    """Host Jacobian int table -> ((24, W, B),)*3 Montgomery limb arrays."""
+    flat = [p for row in table for p in row]
+    coords = []
+    for k in range(3):
+        vals = [p[k] * FQ_MONT_R % Q_MOD for p in flat]
+        arr = ints_to_limbs(vals, FQ_LIMBS).reshape(FQ_LIMBS, N_WINDOWS, N_BUCKETS)
+        coords.append(jnp.asarray(arr))
+    return tuple(coords)
+
+
+def _batch_mul_kernel(tx, ty, tz, digits):
+    """digits: (W, N) uint32 -> ((24, N),)*3 Jacobian sum over windows."""
+    init = CJ.pt_inf((digits.shape[1],))
+
+    def step(acc, x):
+        sx, sy, sz, dg = x  # (24, B) table row + (N,) digit column
+        return CJ.jac_add(acc, (sx[:, dg], sy[:, dg], sz[:, dg])), None
+
+    xs = (tx.transpose(1, 0, 2), ty.transpose(1, 0, 2), tz.transpose(1, 0, 2),
+          digits)
+    acc, _ = lax.scan(step, init, xs)
+    return acc
+
+
+class FixedBaseContext:
+    """Device-resident windowed table for one base point; reusable across
+    batches (the table for G1 is built once per process)."""
+
+    def __init__(self, base_affine):
+        self.table = _table_to_device(_host_window_table(base_affine))
+        self._fn = jax.jit(_batch_mul_kernel)
+
+    def batch_mul(self, scalars):
+        """[s_i]base for host int scalars -> ((24, N),)*3 device Jacobian."""
+        digits = digits_of_scalars(scalars, len(scalars), WINDOW_BITS)
+        return self._fn(*self.table, digits)
+
+
+_G1_CTX = None
+
+
+def g1_batch_mul(scalars):
+    """[s_i]G1 on device, with the G1 table cached process-wide."""
+    global _G1_CTX
+    if _G1_CTX is None:
+        _G1_CTX = FixedBaseContext(C.G1_GEN)
+    return _G1_CTX.batch_mul(scalars)

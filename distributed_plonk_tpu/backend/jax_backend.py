@@ -121,6 +121,12 @@ class JaxBackend:
     def ifft_h(self, domain, h):
         return self._kernel(domain, h, True, False)
 
+    def ifft_many(self, domain, hs):
+        return [self._kernel(domain, h, True, False) for h in hs]
+
+    def coset_fft_many(self, domain, hs):
+        return [self._kernel(domain, h, False, True) for h in hs]
+
     def coset_fft_h(self, domain, h):
         return self._kernel(domain, h, False, True)
 

@@ -247,21 +247,41 @@ def points_to_device(bases_affine, pad):
     return CJ.from_affine(x, y, inf)
 
 
+class DeviceCommitKey:
+    """A commit key that lives on device as Jacobian Montgomery limb arrays
+    (e.g. straight out of the fixed-base SRS generator) — no host affine
+    normalization on the prover path. Identity padding columns (z == 0) are
+    part of the key, mirroring the affine path's None-padded ck list."""
+
+    def __init__(self, px, py, pz):
+        assert px.shape == py.shape == pz.shape == (FQ_LIMBS, px.shape[1])
+        self.point = (px, py, pz)
+
+    def __len__(self):
+        return self.point[0].shape[1]
+
+
 class MsmContext:
     """Device-resident base set (the SRS chunk a worker holds,
     reference src/worker.rs:42-48). Reused across commitments."""
 
-    def __init__(self, bases_affine):
-        n = len(bases_affine)
+    def __init__(self, bases):
+        n = len(bases)
         self.n = n
         pad = n % 2  # groups need >= 2 scan steps
         self.padded_n = n + pad
+        if isinstance(bases, DeviceCommitKey):
+            point = bases.point
+            if pad:
+                point = tuple(jnp.pad(p, ((0, 0), (0, pad))) for p in point)
+            self.point = point
+        else:
+            self.point = points_to_device(bases, pad)
         self.group = _group_size(self.padded_n)
         self.c = window_bits(self.padded_n)
         self._fn = jax.jit(partial(msm_pipeline, group=self.group))
         self._digits_fn = jax.jit(
             partial(digits_from_mont, c=self.c, padded_n=self.padded_n))
-        self.point = points_to_device(bases_affine, pad)
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""

@@ -69,6 +69,15 @@ class PythonBackend:
     def coset_ifft_h(self, domain, h):
         return self.coset_ifft(domain, h)
 
+    # batch NTT entry points: sequential here; the fleet backend overrides
+    # these with concurrent multi-worker dispatch (the join_all pattern,
+    # reference dispatcher2.rs:294-321,382-414)
+    def ifft_many(self, domain, handles):
+        return [self.ifft_h(domain, h) for h in handles]
+
+    def coset_fft_many(self, domain, handles):
+        return [self.coset_fft_h(domain, h) for h in handles]
+
     def blind(self, h, blinds, n):
         return P.poly_add(P.poly_mul_vanishing(blinds, n), h)
 

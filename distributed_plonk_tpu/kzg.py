@@ -47,11 +47,7 @@ class ProvingKey:
         return self.domain.size
 
 
-def universal_setup(max_degree, rng=None, tau=None):
-    """Simulated trusted setup (test SRS; tau is toxic waste).
-
-    Mirrors PlonkKzgSnark::universal_setup (reference src/dispatcher2.rs:1279).
-    """
+def _tau_powers(max_degree, rng=None, tau=None):
     if tau is None:
         rng = rng or random.Random()
         tau = rng.randrange(1, R_MOD)
@@ -60,11 +56,51 @@ def universal_setup(max_degree, rng=None, tau=None):
     for _ in range(max_degree + 1):
         powers.append(acc)
         acc = acc * tau % R_MOD
+    return tau, powers
+
+
+def universal_setup(max_degree, rng=None, tau=None):
+    """Simulated trusted setup (test SRS; tau is toxic waste).
+
+    Mirrors PlonkKzgSnark::universal_setup (reference src/dispatcher2.rs:1279).
+    """
+    tau, powers = _tau_powers(max_degree, rng, tau)
     # batch the scalar muls through one Pippenger-style pass per power is
     # overkill here; direct double-and-add per power (host oracle only).
     powers_of_g1 = [C.g1_mul(C.G1_GEN, p) for p in powers]
     tau_g2 = C.g2_mul(C.G2_GEN, tau)
     return UniversalSrs(powers_of_g1, C.G2_GEN, tau_g2)
+
+
+class DeviceSrs:
+    """SRS whose G1 powers live on device as Jacobian Montgomery limb
+    arrays ((24, N),)*3 — produced by the fixed-base batch kernel, consumed
+    by DeviceCommitKey/MsmContext without ever visiting the host."""
+
+    def __init__(self, jac_powers, count, g2, tau_g2):
+        self.jac_powers = jac_powers
+        self.count = count
+        self.g2 = g2
+        self.tau_g2 = tau_g2
+
+    def powers_affine(self):
+        """Host affine list (test/oracle boundary only: one inversion per
+        point on the host)."""
+        from .backend import curve_jax as CJ
+        return CJ.device_to_affine(self.jac_powers)
+
+
+def universal_setup_device(max_degree, rng=None, tau=None):
+    """Trusted setup with the [tau^i]G1 walk run as one device batch
+    (backend/fixed_base.py) instead of max_degree serial host scalar muls —
+    the setup-scale blocker for reference-size domains (2^18 powers,
+    reference workload src/dispatcher2.rs:1219-1221)."""
+    from .backend.fixed_base import g1_batch_mul
+
+    tau, powers = _tau_powers(max_degree, rng, tau)
+    jac = g1_batch_mul(powers)
+    tau_g2 = C.g2_mul(C.G2_GEN, tau)
+    return DeviceSrs(jac, max_degree + 1, C.G2_GEN, tau_g2)
 
 
 def commit_host(ck, coeffs):
@@ -73,28 +109,49 @@ def commit_host(ck, coeffs):
     return C.g1_msm(ck[:len(coeffs)], coeffs)
 
 
-def preprocess(srs, circuit):
+def preprocess(srs, circuit, backend=None):
     """Build (pk, vk) for a finalized circuit.
 
     Mirrors PlonkKzgSnark::preprocess (reference src/dispatcher2.rs:1280):
     selector/sigma polynomials are iFFTs of their domain evaluations;
     their commitments go into the vk (and the Fiat-Shamir transcript).
+
+    With a backend, the 18 iFFTs and 18 commitments run on its kernels (the
+    commit key of a DeviceSrs stays device-resident, never normalized to
+    host affine); without one, everything runs on the host oracle.
     """
     n = circuit.n
     domain = circuit.eval_domain
     srs_size = n + 3  # degree n+2 polys (blinded z) must be committable
-    assert len(srs.powers_of_g1) >= srs_size, "SRS too small for this circuit"
-    ck = list(srs.powers_of_g1[:srs_size])
-    # pad ck to a multiple of 32 with the identity, as the dispatcher does
-    # (src/dispatcher2.rs:207-208), so MSM shard sizes divide evenly.
-    while len(ck) % 32 != 0:
-        ck.append(None)
+    if isinstance(srs, DeviceSrs):
+        assert backend is not None, "DeviceSrs requires a device backend"
+        assert srs.count >= srs_size, "SRS too small for this circuit"
+        from .backend.msm_jax import DeviceCommitKey
+        import jax.numpy as jnp
+        padded = srs_size + (-srs_size) % 32
+        px, py, pz = (p[:, :srs_size] for p in srs.jac_powers)
+        if padded > srs_size:
+            ext = padded - srs_size
+            px, py, pz = (jnp.pad(p, ((0, 0), (0, ext))) for p in (px, py, pz))
+        ck = DeviceCommitKey(px, py, pz)
+    else:
+        assert len(srs.powers_of_g1) >= srs_size, "SRS too small for this circuit"
+        ck = list(srs.powers_of_g1[:srs_size])
+        # pad ck to a multiple of 32 with the identity, as the dispatcher does
+        # (src/dispatcher2.rs:207-208), so MSM shard sizes divide evenly.
+        while len(ck) % 32 != 0:
+            ck.append(None)
 
-    selectors = [P.ifft(domain, col) for col in circuit.selectors]
-    sigmas = [P.ifft(domain, col) for col in circuit.sigma_values()]
+    ifft = (lambda col: backend.ifft(domain, col)) if backend is not None \
+        else (lambda col: P.ifft(domain, col))
+    commit = (lambda s: backend.commit(ck, s)) if backend is not None \
+        else (lambda s: commit_host(ck, s))
 
-    selector_comms = [commit_host(ck, s) for s in selectors]
-    sigma_comms = [commit_host(ck, s) for s in sigmas]
+    selectors = [ifft(col) for col in circuit.selectors]
+    sigmas = [ifft(col) for col in circuit.sigma_values()]
+
+    selector_comms = [commit(s) for s in selectors]
+    sigma_comms = [commit(s) for s in sigmas]
 
     vk = VerifyingKey(
         domain_size=n,

@@ -66,11 +66,11 @@ def prove(rng, circuit, pk, backend, tracer=None):
     # (reference src/dispatcher2.rs:293-323)
     with tr.span("round1"):
         with tr.span("ifft_wires", polys=num_wire_types):
-            wire_polys = []
-            for values_h in backend.wire_values(circuit):
-                coeffs = backend.ifft_h(domain, values_h)
-                wire_polys.append(
-                    backend.blind(coeffs, _rand(rng, 2), n))
+            # one batch call: concurrent across the fleet (join_all,
+            # reference dispatcher2.rs:294-306) / one launch on device
+            wire_coeffs = backend.ifft_many(domain, backend.wire_values(circuit))
+            wire_polys = [backend.blind(coeffs, _rand(rng, 2), n)
+                          for coeffs in wire_coeffs]
         with tr.span("commit_wires", polys=num_wire_types):
             wires_poly_comms = [backend.commit_h(ck, p) for p in wire_polys]
     transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
@@ -97,13 +97,20 @@ def prove(rng, circuit, pk, backend, tracer=None):
 
     with tr.span("round3"):
         with tr.span("coset_ffts", polys=len(sel_h) + 2 * num_wire_types + 2):
-            selectors_coset = [backend.coset_fft_h(quot_domain, s) for s in sel_h]
-            sigmas_coset = [backend.coset_fft_h(quot_domain, s) for s in sigma_h]
-            wires_coset = [backend.coset_fft_h(quot_domain, w) for w in wire_polys]
-            z_coset = backend.coset_fft_h(quot_domain, permutation_poly)
+            # the 24 coset-FFTs go out as one batch (concurrent across the
+            # fleet / one device launch; reference dispatcher2.rs:382-423)
             pi_coeffs = backend.ifft_h(
                 domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
-            pi_coset = backend.coset_fft_h(quot_domain, pi_coeffs)
+            batch = backend.coset_fft_many(
+                quot_domain,
+                list(sel_h) + list(sigma_h) + wire_polys
+                + [permutation_poly, pi_coeffs])
+            ns, nw = len(sel_h), num_wire_types
+            selectors_coset = batch[:ns]
+            sigmas_coset = batch[ns:ns + nw]
+            wires_coset = batch[ns + nw:ns + 2 * nw]
+            z_coset = batch[ns + 2 * nw]
+            pi_coset = batch[ns + 2 * nw + 1]
 
         with tr.span("quotient_evals", m=m):
             quot_evals = backend.quotient(

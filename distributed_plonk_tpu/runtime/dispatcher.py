@@ -10,11 +10,21 @@ SURVEY.md §2.3.1).
 """
 
 import concurrent.futures as futures
+import random
+import struct
 import threading
 
 from . import native, protocol
 from .. import curve as C
 from ..backend.python_backend import PythonBackend
+
+
+def _split_rc(n):
+    """n = r*c with r = 2^floor(log2(n)/2) (the reference's domain split,
+    /root/reference/src/worker.rs:142-155)."""
+    log_n = n.bit_length() - 1
+    r = 1 << (log_n // 2)
+    return r, n // r
 
 
 class WorkerHandle:
@@ -95,6 +105,60 @@ class Dispatcher:
             lambda ij: self.ntt(ij[1][0], ij[1][1], ij[1][2], worker=ij[0]),
             enumerate(jobs)))
 
+    def fft_dist(self, values, inverse=False, coset=False):
+        """ONE cross-worker sharded 4-step (i)(coset)FFT — the reference's
+        hot protocol (Prover::fft, dispatcher2.rs:731-787): stage-1 rows
+        scattered block-wise, direct worker<->worker all-to-all, stage-2
+        columns gathered. len(values) must be a power of two."""
+        n = len(values)
+        assert n >= 4 and n & (n - 1) == 0, n
+        r, c = _split_rc(n)
+        k = len(self.workers)
+        task_id = random.getrandbits(63)
+        row_bounds = [c * i // k for i in range(k + 1)]
+        col_ranges = [(r * i // k, r * (i + 1) // k) for i in range(k)]
+
+        list(self.pool.map(
+            lambda i: self.workers[i].call(
+                protocol.FFT_INIT, protocol.encode_fft_init(
+                    task_id, inverse, coset, n, r, c,
+                    row_bounds[i], row_bounds[i + 1], col_ranges)),
+            range(k)))
+
+        def scatter(i):
+            rs, re = row_bounds[i], row_bounds[i + 1]
+            if re == rs:
+                return
+            rows = [values[j2::c] for j2 in range(rs, re)]
+            self.workers[i].call(
+                protocol.FFT1, protocol.encode_fft1(task_id, rs, rows))
+
+        list(self.pool.map(scatter, range(k)))
+
+        # trigger the all-to-all; each worker's OK implies its slices landed
+        list(self.pool.map(
+            lambda i: self.workers[i].call(
+                protocol.FFT2_PREPARE, struct.pack("<Q", task_id)),
+            range(k)))
+
+        def gather(i):
+            return protocol.decode_scalars(self.workers[i].call(
+                protocol.FFT2, struct.pack("<Q", task_id)))
+
+        out = [0] * n
+        for i, flat in enumerate(self.pool.map(gather, range(k))):
+            cs, ce = col_ranges[i]
+            for local, k1 in enumerate(range(cs, ce)):
+                row = flat[local * c:(local + 1) * c]
+                out[k1::r] = row
+        return out
+
+    def stats(self):
+        """Per-worker served-request counters {tag: count}."""
+        import json
+        return [json.loads(w.call(protocol.STATS).decode())
+                for w in self.workers]
+
     def shutdown(self):
         for w in self.workers:
             try:
@@ -113,9 +177,15 @@ class RemoteBackend(PythonBackend):
 
     name = "remote"
 
-    def __init__(self, dispatcher):
+    def __init__(self, dispatcher, dist_fft_min=None):
+        """dist_fft_min: domain size at or above which a single NTT is run
+        as the cross-worker sharded 4-step FFT (fft_dist) instead of being
+        shipped whole to one worker; None = never (per-poly parallelism
+        only)."""
         self.d = dispatcher
         self._inited = None
+        self._rr = 0  # round-robin cursor for single NTTs
+        self.dist_fft_min = dist_fft_min
 
     def _ensure_bases(self, bases):
         if self._inited is not bases:
@@ -136,7 +206,25 @@ class RemoteBackend(PythonBackend):
 
     def _ntt(self, domain, values, inverse, coset):
         padded = list(values) + [0] * (domain.size - len(values))
-        return self.d.ntt(padded, inverse, coset)
+        if self.dist_fft_min is not None and domain.size >= self.dist_fft_min:
+            return self.d.fft_dist(padded, inverse, coset)
+        self._rr += 1
+        return self.d.ntt(padded, inverse, coset, worker=self._rr)
+
+    def _many(self, domain, handles, inverse, coset):
+        padded = [list(h) + [0] * (domain.size - len(h)) for h in handles]
+        if self.dist_fft_min is not None and domain.size >= self.dist_fft_min:
+            # each FFT is itself sharded across the whole fleet
+            return [self.d.fft_dist(v, inverse, coset) for v in padded]
+        return self.d.ntt_many([(v, inverse, coset) for v in padded])
+
+    def ifft_many(self, domain, handles):
+        """Concurrent multi-worker batch (join_all across the fleet,
+        reference dispatcher2.rs:294-321)."""
+        return self._many(domain, handles, True, False)
+
+    def coset_fft_many(self, domain, handles):
+        return self._many(domain, handles, False, True)
 
     def msm(self, bases, scalars):
         self._ensure_bases(bases)

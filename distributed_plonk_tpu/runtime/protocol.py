@@ -21,6 +21,16 @@ MSM = 3            # u64 count, count * 32B scalars    -> reply 97B point
 NTT = 4            # u8 flags (1=inverse, 2=coset), u64 n, n * 32B elements
                    #                                   -> reply n * 32B
 SHUTDOWN = 5
+# --- cross-worker sharded 4-step FFT (the reference's distributed-FFT
+# protocol, src/hello_world.capnp:19-23,48 / src/worker.rs:187-438, carried
+# over the host fleet's TCP plane) ---
+FFT_INIT = 6       # u64 id, u8 flags, u64 n/r/c, u64 rs/re/cs/ce -> OK
+FFT1 = 7           # u64 id, u64 first_row, u64 count, count*r*32B -> OK
+FFT2_PREPARE = 8   # u64 id -> OK once all peer exchanges are acknowledged
+FFT_EXCHANGE = 9   # worker->worker: u64 id, u64 col_start, u64 col_count,
+                   # u64 n_rows, then per row: u64 j2, col_count*32B -> OK
+FFT2 = 10          # u64 id -> reply (ce-cs)*c_len*32B stage-2 rows + task GC
+STATS = 11         # -> reply JSON {tag: count} served-request counters
 OK = 100
 ERR = 101
 
@@ -67,6 +77,58 @@ def decode_points(raw):
         out.append(decode_point(raw[off:off + POINT_BYTES]))
         off += POINT_BYTES
     return out
+
+
+def encode_fft_init(task_id, inverse, coset, n, r, c, rs, re, col_ranges):
+    """col_ranges: every worker's stage-2 row range [(cs, ce)] — each worker
+    needs the full table to route its peer exchange."""
+    flags = (1 if inverse else 0) | (2 if coset else 0)
+    head = struct.pack("<QBQQQQQQ", task_id, flags, n, r, c, rs, re,
+                       len(col_ranges))
+    return head + b"".join(struct.pack("<QQ", cs, ce) for cs, ce in col_ranges)
+
+
+def decode_fft_init(raw):
+    task_id, flags, n, r, c, rs, re, k = struct.unpack_from("<QBQQQQQQ", raw, 0)
+    off = struct.calcsize("<QBQQQQQQ")
+    col_ranges = [struct.unpack_from("<QQ", raw, off + 16 * i) for i in range(k)]
+    return (task_id, bool(flags & 1), bool(flags & 2), n, r, c, rs, re,
+            col_ranges)
+
+
+def encode_fft1(task_id, first_row, rows):
+    return (struct.pack("<QQQ", task_id, first_row, len(rows))
+            + b"".join(encode_scalars(r) for r in rows))
+
+
+def decode_fft1(raw):
+    task_id, first_row, count = struct.unpack_from("<QQQ", raw, 0)
+    body = raw[24:]
+    row_len = len(body) // count // FR_BYTES if count else 0
+    rows = [decode_scalars(body[i * row_len * FR_BYTES:(i + 1) * row_len * FR_BYTES])
+            for i in range(count)]
+    return task_id, first_row, rows
+
+
+def encode_fft_exchange(task_id, col_start, col_count, entries):
+    """entries: [(j2, values[col_count])]"""
+    head = struct.pack("<QQQQ", task_id, col_start, col_count, len(entries))
+    body = b"".join(struct.pack("<Q", j2) + encode_scalars(vals)
+                    for j2, vals in entries)
+    return head + body
+
+
+def decode_fft_exchange(raw):
+    task_id, col_start, col_count, n_rows = struct.unpack_from("<QQQQ", raw, 0)
+    off = 32
+    stride = 8 + col_count * FR_BYTES
+    entries = []
+    for _ in range(n_rows):
+        (j2,) = struct.unpack_from("<Q", raw, off)
+        vals = decode_scalars(raw[off + 8:off + stride])
+        entries.append((j2, vals))
+        off += stride
+    return task_id, col_start, col_count, entries
 
 
 def encode_ntt_request(values, inverse, coset):

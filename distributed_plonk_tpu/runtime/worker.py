@@ -3,19 +3,32 @@
 The analog of the reference's worker binary (/root/reference/src/worker.rs:
 441-536): holds device-resident SRS state across requests (State,
 worker.rs:42-59), executes kernels per RPC. Threading model: one thread per
-dispatcher connection, state guarded by a lock — replacing the reference's
+connection, state guarded by a lock — replacing the reference's
 single-thread-plus-unsafe-aliasing design (worker.rs:135 etc.) with an
 actually sound one.
+
+Also serves the cross-worker sharded 4-step FFT (the reference's signature
+protocol): FFT_INIT allocates a task (worker.rs:187-233), FFT1 runs the
+stage-1 row kernels (worker.rs:235-278 -> 66-94), FFT2_PREPARE pushes each
+peer its column slices over direct worker<->worker connections
+(worker.rs:280-345 sender, 412-438 receiver), FFT2 runs the stage-2 column
+kernels and returns the result shard (worker.rs:347-381 -> 96-115). Unlike
+the reference there is no second listener plane: peer exchange frames
+arrive on the same port, distinguished by tag (netconfig.py documents the
+single-plane choice).
 
 Run: python -m distributed_plonk_tpu.runtime.worker <index> [config.json]
     [--backend python|jax]
 """
 
+import struct
 import sys
 import threading
 
 from . import native, protocol
 from .netconfig import NetworkConfig
+from ..constants import R_MOD, FR_GENERATOR
+from ..fields import fr_inv, fr_root_of_unity
 from ..poly import Domain
 
 
@@ -27,17 +40,97 @@ def _make_backend(name):
     return PythonBackend()
 
 
+class FftTask:
+    """In-flight sharded FFT state (the reference's FftTask,
+    /root/reference/src/worker.rs:50-54): stage-1 results for our rows,
+    stage-2 input columns filled in by peer exchanges."""
+
+    def __init__(self, inverse, coset, n, r, c, rs, re, col_ranges, me):
+        self.inverse = inverse
+        self.coset = coset
+        self.n, self.r, self.c = n, r, c
+        self.rs, self.re = rs, re          # our stage-1 rows (j2 indices)
+        self.col_ranges = col_ranges       # every worker's stage-2 range (k1)
+        self.cs, self.ce = col_ranges[me]
+        self.rows = [None] * (re - rs)     # [local j2] -> length-r row
+        self.cols = [[None] * c for _ in range(self.ce - self.cs)]  # [local k1][j2]
+
+
 class WorkerState:
-    def __init__(self, backend):
+    def __init__(self, backend, config=None, me=0):
         self.backend = backend
+        self.config = config
+        self.me = me
         self.bases = None
         self.lock = threading.Lock()
         self.domains = {}
+        self.fft_tasks = {}
+        self.peers = {}
+        self.peer_lock = threading.Lock()
+        self.counters = {}
 
     def domain(self, n):
         if n not in self.domains:
             self.domains[n] = Domain(n)
         return self.domains[n]
+
+    def count(self, tag):
+        with self.lock:
+            self.counters[tag] = self.counters.get(tag, 0) + 1
+
+    def peer(self, p):
+        """Lazy worker->worker connection (the reference opens peer
+        connections per exchange, worker.rs:297-338; here they are cached).
+        Includes the self-loop via TCP, as the reference does."""
+        with self.peer_lock:
+            if p not in self.peers:
+                host, port = self.config.workers[p]
+                conn = native.connect(host, port)
+                self.peers[p] = (conn, threading.Lock())
+            return self.peers[p]
+
+
+def _stage1_row(backend, domain_r, task, j2, row):
+    """Stage-1 kernel for one global row j2 (fft1_helper,
+    /root/reference/src/worker.rs:66-94): optional forward-coset pre-scale
+    g^(j2 + c*j1), r-point (i)FFT, mid twiddle w^(+-j2*k1) — twiddles built
+    incrementally, not per-element pow (improving on worker.rs:77-79)."""
+    n, r, c = task.n, task.r, task.c
+    if task.coset and not task.inverse:
+        gc = pow(FR_GENERATOR, c, R_MOD)
+        t = pow(FR_GENERATOR, j2, R_MOD)
+        scaled = []
+        for v in row:
+            scaled.append(v * t % R_MOD)
+            t = t * gc % R_MOD
+        row = scaled
+    out = backend.ifft(domain_r, row) if task.inverse else backend.fft(domain_r, row)
+    w = fr_root_of_unity(n)
+    base = pow(fr_inv(w) if task.inverse else w, j2, R_MOD)
+    t = 1
+    tw = []
+    for v in out:
+        tw.append(v * t % R_MOD)
+        t = t * base % R_MOD
+    return tw
+
+
+def _stage2_row(backend, domain_c, task, k1, row):
+    """Stage-2 kernel for one global column row k1 (fft2_helper,
+    /root/reference/src/worker.rs:96-115): c-point (i)FFT + inverse-coset
+    post-scale g^-(k1 + r*k2); the 1/n factor comes from the two stage
+    iFFTs (1/r * 1/c), as in the reference."""
+    out = backend.ifft(domain_c, row) if task.inverse else backend.fft(domain_c, row)
+    if task.inverse and task.coset:
+        g_inv = fr_inv(FR_GENERATOR)
+        step = pow(g_inv, task.r, R_MOD)
+        t = pow(g_inv, k1, R_MOD)
+        scaled = []
+        for v in out:
+            scaled.append(v * t % R_MOD)
+            t = t * step % R_MOD
+        return scaled
+    return out
 
 
 def handle(conn, state):
@@ -63,6 +156,7 @@ def handle(conn, state):
 def _dispatch(conn, state, tag, payload):
     """Handle one request frame. Returns False to stop the daemon, anything
     else to keep serving."""
+    state.count(tag)
     if tag == protocol.PING:
         conn.send(protocol.OK)
     elif tag == protocol.INIT_BASES:
@@ -90,6 +184,72 @@ def _dispatch(conn, state, tag, payload):
             else:
                 out = state.backend.fft(domain, values)
         conn.send(protocol.OK, protocol.encode_scalars(out))
+    elif tag == protocol.FFT_INIT:
+        (task_id, inverse, coset, n, r, c, rs, re,
+         col_ranges) = protocol.decode_fft_init(payload)
+        with state.lock:
+            state.fft_tasks[task_id] = FftTask(
+                inverse, coset, n, r, c, rs, re, col_ranges, state.me)
+        conn.send(protocol.OK)
+    elif tag == protocol.FFT1:
+        task_id, first_row, rows = protocol.decode_fft1(payload)
+        with state.lock:
+            task = state.fft_tasks[task_id]
+        domain_r = state.domain(task.r)
+        for off, row in enumerate(rows):
+            j2 = first_row + off
+            task.rows[j2 - task.rs] = _stage1_row(
+                state.backend, domain_r, task, j2, row)
+        conn.send(protocol.OK)
+    elif tag == protocol.FFT2_PREPARE:
+        (task_id,) = struct.unpack_from("<Q", payload, 0)
+        with state.lock:
+            task = state.fft_tasks[task_id]
+        # push every peer its column slice of our rows (the all-to-all,
+        # worker.rs:280-345); each send waits for the peer's ACK, so our OK
+        # to the dispatcher implies all our data has landed
+        for p, (ps, pe) in enumerate(task.col_ranges):
+            if pe == ps or task.re == task.rs:
+                continue
+            entries = [(j2, task.rows[j2 - task.rs][ps:pe])
+                       for j2 in range(task.rs, task.re)]
+            pconn, plock = state.peer(p)
+            with plock:
+                pconn.send(protocol.FFT_EXCHANGE, protocol.encode_fft_exchange(
+                    task_id, ps, pe - ps, entries))
+                rtag, rpayload = pconn.recv()
+            if rtag != protocol.OK:
+                raise RuntimeError(f"peer {p} exchange failed: {rpayload!r}")
+        conn.send(protocol.OK)
+    elif tag == protocol.FFT_EXCHANGE:
+        task_id, col_start, col_count, entries = \
+            protocol.decode_fft_exchange(payload)
+        with state.lock:
+            task = state.fft_tasks[task_id]
+        for j2, vals in entries:
+            for i in range(col_count):
+                task.cols[col_start + i - task.cs][j2] = vals[i]
+        conn.send(protocol.OK)
+    elif tag == protocol.FFT2:
+        (task_id,) = struct.unpack_from("<Q", payload, 0)
+        with state.lock:
+            task = state.fft_tasks[task_id]
+        domain_c = state.domain(task.c)
+        out = []
+        for local, k1 in enumerate(range(task.cs, task.ce)):
+            row = task.cols[local]
+            assert None not in row, f"fft2 before exchange complete (k1={k1})"
+            out.extend(_stage2_row(state.backend, domain_c, task, k1, row))
+        with state.lock:
+            del state.fft_tasks[task_id]  # GC (the reference leaks on abort
+            # too, worker.rs:378; dispatcher failure mid-task leaves the
+            # entry until process restart)
+        conn.send(protocol.OK, protocol.encode_scalars(out))
+    elif tag == protocol.STATS:
+        import json as _json
+        with state.lock:
+            snap = dict(state.counters)
+        conn.send(protocol.OK, _json.dumps(snap).encode())
     elif tag == protocol.SHUTDOWN:
         conn.send(protocol.OK)
         return False
@@ -101,7 +261,7 @@ def _dispatch(conn, state, tag, payload):
 def serve(index, config, backend_name="python", ready_event=None):
     host, port = config.workers[index]
     listener = native.Listener(host, port)
-    state = WorkerState(_make_backend(backend_name))
+    state = WorkerState(_make_backend(backend_name), config=config, me=index)
     if ready_event is not None:
         ready_event.set()
     stop = threading.Event()
