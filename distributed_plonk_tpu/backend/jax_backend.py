@@ -17,6 +17,8 @@ cached device-resident across calls, like the worker's `State`
 (/root/reference/src/worker.rs:42-59).
 """
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -79,6 +81,10 @@ class JaxBackend:
     def commit(self, ck, coeffs):
         return self.msm(ck, coeffs)
 
+    def commit_many(self, ck, coeff_lists):
+        """B commitments over the same key in one batched launch."""
+        return self._ctx(ck).msm_many(coeff_lists)
+
     # --- poly-handle protocol: handles are (16, L) Montgomery arrays --------
 
     def lift(self, values):
@@ -121,11 +127,31 @@ class JaxBackend:
     def ifft_h(self, domain, h):
         return self._kernel(domain, h, True, False)
 
+    # batch NTTs run as single multi-poly launches, chunked so the Fr
+    # mont_mul column tensor (16*16 * B * n * 4B ~ 1 KB per element) stays
+    # ~2 GB: B*n <= 2^21. DPT_NTT_BATCH caps the chunk width
+    _NTT_BATCH = int(os.environ.get("DPT_NTT_BATCH", "8"))
+
+    def _kernel_many(self, domain, hs, inverse, coset):
+        plan = ntt_jax.get_plan(domain.size)
+        chunk = max(1, min(self._NTT_BATCH, (1 << 21) // domain.size))
+        padded = [jnp.pad(h, ((0, 0), (0, domain.size - h.shape[1])))
+                  if h.shape[1] < domain.size else h for h in hs]
+        if chunk == 1:
+            fn1 = plan.kernel(inverse=inverse, coset=coset, boundary="mont")
+            return [fn1(h) for h in padded]
+        fn = plan.kernel_batch(inverse=inverse, coset=coset)
+        out = []
+        for i in range(0, len(padded), chunk):
+            res = fn(jnp.stack(padded[i:i + chunk], axis=1))
+            out.extend(res[:, j] for j in range(res.shape[1]))
+        return out
+
     def ifft_many(self, domain, hs):
-        return [self._kernel(domain, h, True, False) for h in hs]
+        return self._kernel_many(domain, hs, True, False)
 
     def coset_fft_many(self, domain, hs):
-        return [self._kernel(domain, h, False, True) for h in hs]
+        return self._kernel_many(domain, hs, False, True)
 
     def coset_fft_h(self, domain, h):
         return self._kernel(domain, h, False, True)
@@ -139,6 +165,9 @@ class JaxBackend:
     def commit_h(self, ck, h):
         ctx = self._ctx(ck)
         return ctx.msm_mont_limbs(h)
+
+    def commit_many_h(self, ck, hs):
+        return self._ctx(ck).msm_mont_limbs_many(hs)
 
     def degree_is(self, h, d):
         if h.shape[1] <= d:
@@ -156,6 +185,20 @@ class JaxBackend:
         self.lowers += 1  # one scalar crosses the boundary
         zc = jnp.asarray(PJ.lift_scalar(point))
         return PJ.lower(PJ.poly_eval_jit(h, zc))[0]
+
+    def eval_many_h(self, pairs):
+        """[(handle, point)] -> evaluations, in ONE device call: round 4's
+        10 evaluations would otherwise pay 10 dispatch round-trips for 10
+        scalars (the tunnel round-trip is ~0.1s; SURVEY §7 hard part (d))."""
+        from .limbs import limbs_to_ints
+
+        L = max(h.shape[1] for h, _ in pairs)
+        polys = jnp.stack([jnp.pad(h, ((0, 0), (0, L - h.shape[1])))
+                           for h, _ in pairs])  # (B, 16, L)
+        zs = jnp.stack([jnp.asarray(PJ.lift_scalar(p)) for _, p in pairs])
+        out = PJ.poly_eval_many_jit(polys, zs)  # (16, B) canonical
+        self.lowers += 1  # B scalars cross in one transfer
+        return limbs_to_ints(np.asarray(out))
 
     def lin_comb_h(self, polys, coeffs):
         L = max(p.shape[1] for p in polys)

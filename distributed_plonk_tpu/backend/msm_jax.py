@@ -28,6 +28,7 @@ mesh MSM) is O(1) in both n and the number of reduction phases — and every
 memory access is regular.
 """
 
+import os
 from functools import partial
 
 import numpy as np
@@ -64,8 +65,35 @@ def window_bits(n):
 
 
 def _group_size(n):
+    """Private-bucket group count for an n-point MSM.
+
+    The accumulation scan does n*W lane-adds no matter what; the plane
+    fold does G*W*2^c more. Measured on v5e (2.5us/lane-add end to end),
+    total time tracks total lane-adds almost linearly, so G is kept at
+    ~n/1024 — fold work <= 25% of scan work — instead of the old fixed 512
+    (which at n=9216 made the fold 14x the scan and a 5-poly commit batch
+    8x slower than G=8)."""
     g = 512
-    while g > 1 and (n % g != 0 or n // g < 2):
+    while g > 1 and (n % g != 0 or n // g < 2 or g * 1024 > n):
+        g //= 2
+    return g
+
+
+# peak bucket-plane footprint allowed for a batched MSM (all three Jacobian
+# coords); beyond this the group width halves, trading scan steps for HBM
+_PLANE_BYTES_BUDGET = int(os.environ.get("DPT_MSM_PLANE_MB", "1536")) << 20
+
+
+def _group_size_batch(n, batch, c):
+    """Group width for a B-poly batched MSM: work-optimal size per
+    _group_size, further capped so the plane array (which scales with
+    group * B * W * 2^c) stays in budget."""
+    w = SCALAR_BITS // c
+    per_group = 3 * 4 * FQ_LIMBS * batch * w * (1 << c)
+    g = _group_size(n)
+    while g > 1 and g * per_group > _PLANE_BYTES_BUDGET:
+        g //= 2
+    while g > 1 and n % g != 0:
         g //= 2
     return g
 
@@ -111,7 +139,12 @@ def fold_planes(bx, by, bz):
 
     Used for both the group fold and the mesh cross-device fold: the scan
     body is identical in both calls, so XLA compiles it once per program.
-    """
+    (A log-depth pairwise tree was tried here and reverted: its first
+    level is a jac_add over K/2 planes at once, whose mont_mul column
+    tensors transiently need ~150x the plane bytes — 33 GB at a batched
+    2^10 MSM. The scan touches one plane per step, keeping transients at
+    1/K of that; with batched pipelines the per-step lanes are wide enough
+    that the sequential depth is not the bottleneck.)"""
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
     init = tuple(b + vz for b in CJ.pt_inf(bz.shape[2:]))
 
@@ -188,14 +221,35 @@ def finish(bx, by, bz):
     return tuple(v[:, 0] for v in acc)
 
 
-def msm_pipeline(px, py, pz, digits, group):
-    """Full single-device MSM: points (24, n) + digits (W, n) -> total."""
-    buckets = 1 << (SCALAR_BITS // digits.shape[0])
+def bucket_planes_batch(px, py, pz, digits, group):
+    """B-polynomial bucket accumulation over SHARED bases: points (24, nc)
+    + digits (B, W, nc) -> folded planes ((24, B*W, 2^c),)*3.
+
+    The prover's per-round commitment batches (5 wires, 5 quotient splits,
+    2 openings — the join_all fan-outs of reference dispatcher2.rs:316-321,
+    526-533) share every scan step, so fixed per-step latency is paid once
+    per round instead of once per polynomial."""
+    B, W, n = digits.shape
+    buckets = 1 << (SCALAR_BITS // W)
+    flat = digits.reshape(B * W, n)
     wb = jax.vmap(partial(_bucket_scan, group=group, n_buckets=buckets),
-                  in_axes=(None, None, None, 0))(px, py, pz, digits)
-    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)  # (G, 24, W, B)
-    acc = fold_planes(*planes)
-    return finish(*acc)
+                  in_axes=(None, None, None, 0))(px, py, pz, flat)
+    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)  # (G, 24, B*W, buckets)
+    return fold_planes(*planes)
+
+
+def finish_batch(acc_x, acc_y, acc_z, batch):
+    """((24, B*W, 2^c),)*3 folded planes -> ((24, B),)*3 totals."""
+    acc_b = tuple(a.reshape(FQ_LIMBS, batch, a.shape[1] // batch, a.shape[2])
+                  for a in (acc_x, acc_y, acc_z))
+    return jax.vmap(finish, in_axes=(1, 1, 1), out_axes=1)(*acc_b)
+
+
+def msm_pipeline_batch(px, py, pz, digits, group):
+    """One-shot batched MSM (small inputs / tests): bucket accumulation +
+    finish in a single program."""
+    acc = bucket_planes_batch(px, py, pz, digits, group)
+    return finish_batch(*acc, batch=digits.shape[0])
 
 
 def digits_from_mont(v, c, padded_n):
@@ -277,29 +331,97 @@ class MsmContext:
             self.point = point
         else:
             self.point = points_to_device(bases, pad)
-        self.group = _group_size(self.padded_n)
         self.c = window_bits(self.padded_n)
-        self._fn = jax.jit(partial(msm_pipeline, group=self.group))
-        self._digits_fn = jax.jit(
-            partial(digits_from_mont, c=self.c, padded_n=self.padded_n))
+        # batched pipelines always use 8-bit windows once the key is big
+        # enough: 2^8 buckets exactly fill the (8, 128) minor tile, where a
+        # 16-bucket (c=4) plane is layout-padded 8x — the difference between
+        # a 1.2 GB and a 10+ GB program at a batched 2^10 commit
+        self.c_batch = 8 if self.padded_n >= 256 else self.c
+        self._digits_batch_fn = jax.jit(
+            partial(digits_from_mont, c=self.c_batch, padded_n=self.padded_n))
+        self._chunk_fns = {}
+        self._finish_fns = {}
+        self._merge_fn = jax.jit(
+            lambda a, b: CJ.jac_add(tuple(a), tuple(b)))
+
+    # one device execution is kept under ~10^7 lane-adds (~25 s at the
+    # measured 2.5 us/lane-add): the tunneled runtime kills executions in
+    # the ~60 s range ("TPU worker process crashed"), observed for single
+    # calls at 2^19 points and above
+    _CALL_ADDS = int(os.environ.get("DPT_MSM_CALL_ADDS", "8000000"))
+
+    def _chunk_fn(self, nc, group):
+        key = (nc, group)
+        if key not in self._chunk_fns:
+            self._chunk_fns[key] = jax.jit(
+                partial(bucket_planes_batch, group=group))
+        return self._chunk_fns[key]
+
+    def _finish_fn(self, batch):
+        if batch not in self._finish_fns:
+            self._finish_fns[batch] = jax.jit(
+                partial(finish_batch, batch=batch))
+        return self._finish_fns[batch]
+
+    def _exec_chunked(self, digits):
+        """digits (B, W, padded_n) -> ((24, B),)*3 totals, in as many
+        device calls as the per-call budget requires: per-chunk bucket
+        accumulation, cheap cross-chunk plane merges, one finish tail."""
+        B, W, n = digits.shape
+        chunk = max(1024, (self._CALL_ADDS // (B * W)) & ~1023)
+        px, py, pz = self.point
+        acc = None
+        for i0 in range(0, n, chunk):
+            nc = min(chunk, n - i0)
+            g = _group_size_batch(nc, B, SCALAR_BITS // W)
+            part = self._chunk_fn(nc, g)(
+                px[:, i0:i0 + nc], py[:, i0:i0 + nc], pz[:, i0:i0 + nc],
+                digits[:, :, i0:i0 + nc])
+            acc = part if acc is None else tuple(self._merge_fn(acc, part))
+        return self._finish_fn(B)(*acc)
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
         assert len(scalars) <= self.n
-        digits = digits_of_scalars(scalars, self.padded_n, self.c)
-        px, py, pz = self.point
-        tx, ty, tz = self._fn(px, py, pz, digits)
-        return _jac_limbs_to_affine(tx, ty, tz)
+        return self.msm_many([scalars])[0]
 
     def msm_mont_limbs(self, h):
         """Commit a (16, L <= padded_n) Montgomery Fr coefficient handle:
         digit extraction happens on device; only the resulting group
         element returns to the host (for the transcript)."""
-        assert h.shape[1] <= self.n, (h.shape, self.n)
-        digits = self._digits_fn(h)
-        px, py, pz = self.point
-        tx, ty, tz = self._fn(px, py, pz, digits)
-        return _jac_limbs_to_affine(tx, ty, tz)
+        return self.msm_mont_limbs_many([h])[0]
+
+    # batched launches are chunked: bucket planes and mont_mul transients
+    # scale with B, and a fixed chunk width keeps the set of compiled batch
+    # shapes small across prover rounds (8, then the 5/2-size residuals)
+    _BATCH_CHUNK = int(os.environ.get("DPT_MSM_BATCH", "8"))
+
+    def _run_batches(self, items, make_digits):
+        """items -> affine points; digits are materialized per batch chunk
+        so peak digit memory is _BATCH_CHUNK tensors, not len(items)."""
+        out = []
+        for i in range(0, len(items), self._BATCH_CHUNK):
+            digits = jnp.stack(
+                [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
+            tx, ty, tz = self._exec_chunked(digits)
+            tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
+            out.extend(_jac_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
+                       for j in range(digits.shape[0]))
+        return out
+
+    def msm_mont_limbs_many(self, hs):
+        """Commit B Montgomery coefficient handles in batched launches;
+        returns B affine points (host ints)."""
+        for h in hs:
+            assert h.shape[1] <= self.n, (h.shape, self.n)
+        return self._run_batches(hs, self._digits_batch_fn)
+
+    def msm_many(self, scalar_lists):
+        """B MSMs over host int scalar lists in batched launches."""
+        return self._run_batches(
+            scalar_lists,
+            lambda s: jnp.asarray(
+                digits_of_scalars(s, self.padded_n, self.c_batch)))
 
 
 def _jac_limbs_to_affine(tx, ty, tz):

@@ -170,6 +170,43 @@ class NttPlan:
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
+    def kernel_batch(self, inverse=False, coset=False):
+        """Jitted (16, B, n) -> (16, B, n) Montgomery-boundary kernel: B
+        polynomials in ONE launch (the prover's round-1/round-3 NTT batches;
+        the reference fans these out as concurrent RPCs,
+        dispatcher2.rs:294-321,382-414 — on device they are one program).
+        Compiled once per (mode, B)."""
+        key = (inverse, coset, "batch")
+        if key not in self._fns:
+            n = self.n
+            consts = {
+                "perm": jnp.asarray(self.perm),
+                "exps": jnp.asarray(self.exps),
+                "pow": jnp.asarray(self.pow_inv if inverse else self.pow_fwd),
+            }
+            if coset and not inverse:
+                consts["pre"] = jnp.asarray(self.coset_tab)
+            if inverse:
+                consts["post"] = jnp.asarray(
+                    self.inv_coset_tab if coset else self.n_inv_tab)
+
+            @jax.jit
+            def fn(v, consts):
+                if "pre" in consts:
+                    v = FJ.mont_mul(FR, v, consts["pre"][:, None, :])
+                v = batched_butterflies(
+                    v, consts["perm"], consts["exps"], consts["pow"])
+                if "post" in consts:
+                    post = consts["post"]
+                    if post.shape[1] == 1:  # plain 1/n: broadcast symbolically
+                        post = jnp.broadcast_to(post, (FR_LIMBS, n))
+                    v = FJ.mont_mul(FR, v, post[:, None, :])
+                return v
+
+            self._fns[key] = (fn, consts)
+        fn, consts = self._fns[key]
+        return lambda v: fn(v, consts)
+
     # --- host-boundary convenience (int lists, zero-padded to n) -------------
 
     def run_ints(self, values, inverse=False, coset=False):

@@ -229,6 +229,15 @@ def poly_eval(poly, zc, chunk=256):
     return terms[:, :1]
 
 
+def poly_eval_many(polys, zs):
+    """Batched evaluation: (B, 16, L) polys at (B, 16, 1) points -> (16, B)
+    CANONICAL-form limbs. One device program (and one host round-trip) for
+    the prover's whole round 4 — per-call dispatch latency dominates
+    scalar-result kernels on a tunneled device."""
+    evals = jax.vmap(poly_eval)(polys, zs)  # (B, 16, 1)
+    return FJ.from_mont(FR, evals[:, :, 0].transpose(1, 0))
+
+
 def synthetic_divide(poly, zc):
     """Quotient of p(X)/(X - z) (remainder discarded) for a (16, 1)
     Montgomery point, device analog of poly.synthetic_divide:
@@ -287,6 +296,7 @@ def tail_is_zero(poly, degree):
 
 _from_mont_jit = jax.jit(partial(FJ.from_mont, FR))
 poly_eval_jit = jax.jit(poly_eval)
+poly_eval_many_jit = jax.jit(poly_eval_many)
 synthetic_divide_jit = jax.jit(synthetic_divide)
 lin_comb_jit = jax.jit(lin_comb)
 blind_jit = jax.jit(add_vanishing_blind, static_argnums=2)

@@ -84,6 +84,15 @@ class PythonBackend:
     def commit_h(self, ck, h):
         return self.commit(ck, _pad(h, len(ck)))
 
+    # batch commitment entry points (the reference's join_all commit
+    # fan-outs, dispatcher2.rs:316-321,526-533): sequential here; the
+    # device backend overrides with one batched multi-poly MSM launch
+    def commit_many(self, ck, coeff_lists):
+        return [self.commit(ck, s) for s in coeff_lists]
+
+    def commit_many_h(self, ck, hs):
+        return [self.commit_h(ck, h) for h in hs]
+
     def degree_is(self, h, d):
         return P.poly_degree(h) == d
 
@@ -94,6 +103,9 @@ class PythonBackend:
 
     def eval_h(self, h, point):
         return P.poly_eval(h, point)
+
+    def eval_many_h(self, pairs):
+        return [self.eval_h(h, point) for h, point in pairs]
 
     def lin_comb_h(self, polys, coeffs):
         out = []

@@ -128,7 +128,11 @@ def preprocess(srs, circuit, backend=None):
         assert srs.count >= srs_size, "SRS too small for this circuit"
         from .backend.msm_jax import DeviceCommitKey
         import jax.numpy as jnp
-        padded = srs_size + (-srs_size) % 32
+        # pad further than the reference's x32 (dispatcher2.rs:207-208):
+        # x1024 keeps the MSM bucket-scan group width at its 512 maximum
+        # (msm_jax._group_size needs group | n), e.g. at the 2^18+3 SRS of
+        # the 50-proof workload; identity padding never changes commitments
+        padded = srs_size + (-srs_size) % 1024
         px, py, pz = (p[:, :srs_size] for p in srs.jac_powers)
         if padded > srs_size:
             ext = padded - srs_size
@@ -142,16 +146,17 @@ def preprocess(srs, circuit, backend=None):
         while len(ck) % 32 != 0:
             ck.append(None)
 
-    ifft = (lambda col: backend.ifft(domain, col)) if backend is not None \
-        else (lambda col: P.ifft(domain, col))
-    commit = (lambda s: backend.commit(ck, s)) if backend is not None \
-        else (lambda s: commit_host(ck, s))
-
-    selectors = [ifft(col) for col in circuit.selectors]
-    sigmas = [ifft(col) for col in circuit.sigma_values()]
-
-    selector_comms = [commit(s) for s in selectors]
-    sigma_comms = [commit(s) for s in sigmas]
+    if backend is not None:
+        selectors = [backend.ifft(domain, col) for col in circuit.selectors]
+        sigmas = [backend.ifft(domain, col) for col in circuit.sigma_values()]
+        comms = backend.commit_many(ck, selectors + sigmas)
+        selector_comms = comms[:len(selectors)]
+        sigma_comms = comms[len(selectors):]
+    else:
+        selectors = [P.ifft(domain, col) for col in circuit.selectors]
+        sigmas = [P.ifft(domain, col) for col in circuit.sigma_values()]
+        selector_comms = [commit_host(ck, s) for s in selectors]
+        sigma_comms = [commit_host(ck, s) for s in sigmas]
 
     vk = VerifyingKey(
         domain_size=n,

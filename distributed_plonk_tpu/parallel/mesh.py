@@ -14,6 +14,29 @@ import jax
 SHARD_AXIS = "shards"
 
 
+def init_multihost(coordinator, num_processes, process_id,
+                   local_device_ids=None):
+    """Join a multi-host (DCN) mesh group: after this, jax.devices() spans
+    every host and make_mesh() builds cross-host meshes whose collectives
+    ride ICI within a pod and DCN across pods.
+
+    This is the multi-controller replacement for the reference's
+    dispatcher->worker star + worker<->worker peer mesh
+    (/root/reference/config/network.json, src/worker.rs:441-536): instead
+    of one coordinator driving RPC fan-outs, every host runs the same
+    program and XLA inserts the cross-host collectives.
+
+    coordinator: "host:port" of process 0 (the network.json analog).
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return jax.process_count(), jax.device_count()
+
+
 def make_mesh(n_devices=None, platform=None):
     """1-D mesh over the first n_devices (default: all) devices.
 

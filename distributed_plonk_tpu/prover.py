@@ -72,7 +72,7 @@ def prove(rng, circuit, pk, backend, tracer=None):
             wire_polys = [backend.blind(coeffs, _rand(rng, 2), n)
                           for coeffs in wire_coeffs]
         with tr.span("commit_wires", polys=num_wire_types):
-            wires_poly_comms = [backend.commit_h(ck, p) for p in wire_polys]
+            wires_poly_comms = backend.commit_many_h(ck, wire_polys)
     transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
 
     # --- Round 2: permutation product ----------------------------------------
@@ -127,21 +127,21 @@ def prove(rng, circuit, pk, backend, tracer=None):
         split_quot_polys = backend.split(
             quotient_poly, n + 2, num_wire_types, expected_degree + 1)
         with tr.span("commit_quot", polys=len(split_quot_polys)):
-            split_quot_poly_comms = [
-                backend.commit_h(ck, t) for t in split_quot_polys
-            ]
+            split_quot_poly_comms = backend.commit_many_h(ck, split_quot_polys)
     transcript.append_commitments(b"quot_poly_comms", split_quot_poly_comms)
 
     # --- Round 4: evaluations ------------------------------------------------
     # (reference src/dispatcher2.rs:542-561)
     zeta = transcript.get_and_append_challenge(b"zeta")
     with tr.span("round4"):
-        wires_evals = [backend.eval_h(w, zeta) for w in wire_polys]
-        wire_sigma_evals = [
-            backend.eval_h(s, zeta) for s in sigma_h[:num_wire_types - 1]
-        ]
-        perm_next_eval = backend.eval_h(
-            permutation_poly, zeta * domain.group_gen % R_MOD)
+        # all 10 evaluations in one backend call (one device round-trip)
+        evals = backend.eval_many_h(
+            [(w, zeta) for w in wire_polys]
+            + [(s, zeta) for s in sigma_h[:num_wire_types - 1]]
+            + [(permutation_poly, zeta * domain.group_gen % R_MOD)])
+        wires_evals = evals[:num_wire_types]
+        wire_sigma_evals = evals[num_wire_types:2 * num_wire_types - 1]
+        perm_next_eval = evals[-1]
     transcript.append_proof_evaluations(wires_evals, wire_sigma_evals, perm_next_eval)
 
     # --- Round 5: linearization + openings -----------------------------------
@@ -166,11 +166,10 @@ def prove(rng, circuit, pk, backend, tracer=None):
                 c = c * v % R_MOD
             batch_poly = backend.lin_comb_h(polys, coeffs)
             witness_poly = backend.synth_div_h(batch_poly, zeta)
-            opening_proof = backend.commit_h(ck, witness_poly)
-
             shifted_witness_poly = backend.synth_div_h(
                 permutation_poly, zeta * domain.group_gen % R_MOD)
-            shifted_opening_proof = backend.commit_h(ck, shifted_witness_poly)
+            opening_proof, shifted_opening_proof = backend.commit_many_h(
+                ck, [witness_poly, shifted_witness_poly])
 
     return Proof(
         wires_poly_comms, prod_perm_poly_comm, split_quot_poly_comms,

@@ -21,10 +21,10 @@ def test_jax_prove_verifies_and_matches_oracle(proven):
 
     # device residency: O(n) host->device uploads are the proving key, the
     # circuit witness/permutation tables (once each, cached) and the
-    # public-input vector; the only lowers are the 10 round-4 evaluations
-    # (everything else stays on device between rounds)
+    # public-input vector; the only lower is the single batched round-4
+    # evaluation transfer (everything else stays on device between rounds)
     assert be.lifts == 3, be.lifts
-    assert be.lowers == 10, be.lowers
+    assert be.lowers == 1, be.lowers
 
     # bit-identical across backends (the reference's core invariant:
     # distributed == single-node, SURVEY.md §4)

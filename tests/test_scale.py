@@ -1,0 +1,37 @@
+"""Reference-scale end-to-end workloads (opt-in: slow, device-bound).
+
+The reference's two built-in workloads (SURVEY.md §6): height-32 Merkle
+membership at 1 proof -> 2^13 domain (v1, dispatcher.rs:1064-1070) and at
+50 proofs -> 2^18 domain / 2^21 quotient (v2, dispatcher2.rs:1219-1221).
+Run with DPT_SCALE_TEST=1 (and ideally on the real chip: the default test
+env pins JAX_PLATFORMS=cpu); scripts/scale_run.py is the standalone
+driver for the same flow with timing output.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("DPT_SCALE_TEST"),
+    reason="reference-scale run is opt-in (DPT_SCALE_TEST=1); "
+           "it cold-compiles large-domain kernels")
+
+
+def test_height32_one_proof_2p13():
+    env = dict(os.environ)
+    # let the script inherit the real-device platform if available
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "scale_run.py"),
+         "--height", "32", "--proofs", "1"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=7200)
+    assert out.returncode == 0, out.stderr[-2000:]
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    assert res["log2_n"] == 13, res
+    assert res["verified"] is True
