@@ -10,21 +10,30 @@ import os
 
 # Force CPU even when the environment preselects the real TPU platform
 # (JAX_PLATFORMS=axon): per-op tunnel latency makes eager tests unusable, and
-# the sharding tests need the 8-device virtual mesh.
+# the sharding tests need the 8-device virtual mesh. Also scrub the relay
+# trigger variables entirely — round-2 post-mortem: with the relay dead,
+# platform discovery blocks forever at ~0 CPU, so a suite that merely pins
+# JAX_PLATFORMS=cpu but leaves PALLAS_AXON_POOL_IPS set can still hang in
+# subprocesses it spawns (worker fleet, dryrun). Tests that need the real
+# chip must opt in explicitly.
+for _k in list(os.environ):
+    if _k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
+        os.environ.pop(_k)
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize imports jax at interpreter startup, so jax's config
+# already captured JAX_PLATFORMS=axon before this file ran — the env
+# assignment above only covers subprocesses. Pin the in-process config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 # The persistent compilation cache itself is configured by
 # distributed_plonk_tpu.backend.field_jax at import time.
-
-# NOTE: a site-installed TPU plugin (axon) may override JAX_PLATFORMS at
-# interpreter startup, in which case single-device tests run on the real
-# chip (with its remote-compile service) — that is deliberate extra
-# coverage of the TPU lowering. The mesh tests pin platform="cpu"
-# explicitly, so the 8-device virtual mesh is exercised either way.
 
 import pytest
 
