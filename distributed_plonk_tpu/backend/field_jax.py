@@ -51,6 +51,29 @@ from ..constants import (
 from .limbs import int_to_limbs
 
 
+def _const_bytes(value, n_bytes):
+    """Host int -> (n_bytes,) radix-2^8 little-endian digits (numpy)."""
+    return np.array([(value >> (8 * i)) & 0xFF for i in range(n_bytes)],
+                    dtype=np.float32)
+
+
+def _toeplitz_bytes(value, in_bytes, out_bytes):
+    """Constant banded (Toeplitz) matrix T with T[k, i] = byte_{k-i}(value):
+    T @ a8 gives the byte-column sums of value * a for any a presented as
+    (in_bytes, *batch) radix-2^8 digits — i.e. multiplication by a constant
+    is literally a matmul, which XLA tiles onto the MXU (bf16 x bf16 with
+    f32 accumulation; every operand is an integer <= 255, every column sum
+    <= 96 * 255^2 < 2^23, so the float path is exact)."""
+    bts = _const_bytes(value, in_bytes)  # constant has <= in_bytes bytes here
+    T = np.zeros((out_bytes, in_bytes), dtype=np.float32)
+    for k in range(out_bytes):
+        for i in range(in_bytes):
+            j = k - i
+            if 0 <= j < in_bytes:
+                T[k, i] = bts[j]
+    return T
+
+
 class FieldSpec:
     """Static per-field constants (host numpy; embedded into jit traces)."""
 
@@ -67,6 +90,12 @@ class FieldSpec:
         # bit flagging whether the subtraction stayed nonnegative
         self.negmod_limbs = int_to_limbs((1 << (LIMB_BITS * n_limbs)) - mod,
                                          n_limbs)
+        # MXU operands for the two constant products of Montgomery SOS
+        # (t_lo * ninv mod R needs only the low half; m * p needs the full
+        # double-width product) — see mont_mul
+        nb = 2 * n_limbs
+        self.ninv_toeplitz = _toeplitz_bytes(mont_inv % (1 << (8 * nb)), nb, nb)
+        self.mod_toeplitz = _toeplitz_bytes(mod, nb, 2 * nb)
 
 
 FR = FieldSpec("Fr", R_MOD, FR_LIMBS, FR_MONT_R2, FR_MONT_INV)
@@ -113,15 +142,16 @@ def _carry_sweep(cols):
     return limbs, carry
 
 
-def _skew_colsum(m, shift):
+def _skew_colsum(m, shift, dtype=jnp.uint32):
     """Anti-diagonal column sums: out[k] = Σ_i m[i, k - i - shift].
 
     m: (rows, w, *batch). Each row i is logically shifted right by i+shift,
     then columns are summed — computed with pure pad/reshape/slice/reduce
     (row i of the flattened (rows, W-1) view starts at i·(W-1) = i·W - i,
     i.e. sits i slots earlier, which IS the skew), so the traced program is
-    O(1) ops instead of an O(rows) chain of dynamic-update-slices. Entries
-    must be < 2^16 so sums of <= rows <= 48 terms stay far below 2^32.
+    O(1) ops instead of an O(rows) chain of dynamic-update-slices. Integer
+    entries must be < 2^16 (sums of <= 96 terms stay far below 2^32);
+    float entries must keep sums < 2^24 so f32 accumulation stays exact.
     """
     rows, w = m.shape[0], m.shape[1]
     batch = m.shape[2:]
@@ -131,22 +161,75 @@ def _skew_colsum(m, shift):
     W = w + shift + rows
     flat = mp.reshape((rows * W,) + batch)
     skewed = flat[: rows * (W - 1)].reshape((rows, W - 1) + batch)
-    return jnp.sum(skewed, axis=0, dtype=jnp.uint32)  # (W-1, *batch)
+    return jnp.sum(skewed, axis=0, dtype=dtype)  # (W-1, *batch)
 
 
-def _mul_columns(a, b, out_limbs):
-    """Carry-free column sums of the product, truncated to out_limbs limbs."""
+# float limb products (DPT_FIELD_MUL=f32, default) vs the round-2 u32 path:
+# TPU vector units have no native 32-bit integer multiply — the measured u32
+# multiply rate (~38 Gops/s on v5e) is an emulation ~50x below the f32 FMA
+# rate — so limb products are computed on 8-bit sub-limbs in f32 (exact:
+# products <= 255^2, anti-diagonal sums <= 96*255^2 < 2^23 < 2^24) and the
+# two constant products of Montgomery SOS additionally become bf16 MXU
+# matmuls against banded Toeplitz matrices (_toeplitz_bytes).
+_F32_MUL = os.environ.get("DPT_FIELD_MUL", "f32") != "u32"
+
+
+def _bytes_f32(a):
+    """(L, *b) u32 16-bit limbs -> (2L, *b) f32 radix-2^8 digits."""
+    lo = (a & 0xFF).astype(jnp.float32)
+    hi = ((a >> 8) & 0xFF).astype(jnp.float32)
+    s = jnp.stack([lo, hi], axis=1)  # (L, 2, *b)
+    return s.reshape((2 * a.shape[0],) + a.shape[1:])
+
+
+def _combine_byte_cols(col8, out_limbs):
+    """(K8, *b) f32 byte-column sums (each < 2^23, exact) -> (out_limbs, *b)
+    u32 16-bit-column sums: out[k] = col8[2k] + 2^8 * col8[2k+1] (< 2^31)."""
+    c = col8.astype(jnp.uint32)
+    c = _pad_rows(c, 2 * out_limbs)[: 2 * out_limbs]
+    ev = c[0::2]
+    od = c[1::2]
+    return ev + (od << 8)
+
+
+def _mul_columns_f32(a, b, out_limbs):
+    """Variable x variable product columns via exact f32 byte products."""
+    a8 = _bytes_f32(a)
+    b8 = _bytes_f32(b)
+    p = a8[:, None] * b8[None, :]  # (2la, 2lb, *batch), exact (<= 255^2)
+    col8 = _skew_colsum(p, 0, dtype=jnp.float32)
+    return _combine_byte_cols(col8, out_limbs)
+
+
+def _mul_columns_const(T, a, out_limbs):
+    """Constant x variable product columns as ONE matmul: T is a banded
+    byte-Toeplitz host matrix (_toeplitz_bytes), a is (L, *batch) 16-bit
+    limbs. bf16 operands (integers <= 255: exact), f32 accumulation
+    (column sums < 2^23: exact) — this is the MXU path."""
+    a8 = _bytes_f32(a).astype(jnp.bfloat16)
+    col8 = jax.lax.dot_general(
+        jnp.asarray(T, dtype=jnp.bfloat16), a8,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return _combine_byte_cols(col8, out_limbs)
+
+
+def _mul_columns_u32(a, b, out_limbs):
+    """Round-2 u32 fallback path (DPT_FIELD_MUL=u32)."""
     la, lb = a.shape[0], b.shape[0]
     p = a[:, None] * b[None, :]  # (la, lb, *batch), each product < 2^32
     lo = _skew_colsum(p & LIMB_MASK, 0)  # cols 0 .. la+lb-2
     hi = _skew_colsum(p >> LIMB_BITS, 1)  # cols 1 .. la+lb-1
-    lo = lo[:out_limbs]
-    hi = hi[:out_limbs]
-    if lo.shape[0] < out_limbs:
-        lo = jnp.pad(lo, [(0, out_limbs - lo.shape[0])] + [(0, 0)] * (lo.ndim - 1))
-    if hi.shape[0] < out_limbs:
-        hi = jnp.pad(hi, [(0, out_limbs - hi.shape[0])] + [(0, 0)] * (hi.ndim - 1))
+    lo = _pad_rows(lo[:out_limbs], out_limbs)
+    hi = _pad_rows(hi[:out_limbs], out_limbs)
     return lo + hi
+
+
+def _mul_columns(a, b, out_limbs):
+    """Carry-free column sums of the product, truncated to out_limbs limbs."""
+    if _F32_MUL:
+        return _mul_columns_f32(a, b, out_limbs)
+    return _mul_columns_u32(a, b, out_limbs)
 
 
 def _pad_rows(a, n):
@@ -214,10 +297,16 @@ def mont_mul(spec, a, b):
     l = spec.n_limbs
     t_cols = _mul_columns(a, b, 2 * l)  # a*b < p^2, uncarried
     t_lo, c_t = _carry_sweep(t_cols[:l])  # exact t mod R + carry into col l
-    ninv = _bcast_const(spec.ninv_limbs, a.ndim)
-    m, _ = _carry_sweep(_mul_columns(t_lo, ninv, l))  # m = (t mod R)*(-p^-1) mod R
-    p = _bcast_const(spec.mod_limbs, a.ndim)
-    mp_cols = _mul_columns(m, p, 2 * l)  # m*p < R*p, uncarried
+    if _F32_MUL:
+        # constant products ride the MXU as banded-Toeplitz matmuls
+        m_cols = _mul_columns_const(spec.ninv_toeplitz, t_lo, l)
+        m, _ = _carry_sweep(m_cols)  # m = (t mod R)*(-p^-1) mod R
+        mp_cols = _mul_columns_const(spec.mod_toeplitz, m, 2 * l)
+    else:
+        ninv = _bcast_const(spec.ninv_limbs, a.ndim)
+        m, _ = _carry_sweep(_mul_columns(t_lo, ninv, l))
+        p = _bcast_const(spec.mod_limbs, a.ndim)
+        mp_cols = _mul_columns(m, p, 2 * l)  # m*p < R*p, uncarried
     # low half of t + m*p is == 0 mod R: only its carry-out matters
     _, c_lo = _carry_sweep(mp_cols[:l] + t_lo)
     hi = (mp_cols[l:] + t_cols[l:]).at[0].add(c_t + c_lo)

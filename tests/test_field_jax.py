@@ -106,3 +106,50 @@ def test_predicates_and_select():
     assert list(np.asarray(z)) == [True, False, False, True]
     assert list(np.asarray(e)) == [True, True, False, False]
     assert limbs_to_ints(sel) == [0, 5, R_MOD - 1, 1]
+
+
+def test_mul_columns_f32_matches_u32_at_extremes():
+    """The f32 byte-product path (VPU float products + MXU constant
+    Toeplitz matmuls) must agree with the u32 reference path bit-for-bit,
+    including at all-0xFFFF limbs where the exactness bounds
+    (products <= 255^2, column sums < 2^23) are tight."""
+    for l in (FJ.FR.n_limbs, FJ.FQ.n_limbs):
+        cases = [
+            np.full((l, 4), 0xFFFF, dtype=np.uint32),
+            np.zeros((l, 4), dtype=np.uint32),
+            np.asarray(ints_to_limbs(
+                [RNG.randrange(1 << (16 * l)) for _ in range(4)], l)),
+        ]
+        for a_np in cases:
+            for b_np in cases:
+                a, b = jnp.asarray(a_np), jnp.asarray(b_np)
+                got = jax.jit(
+                    lambda a, b: FJ._mul_columns_f32(a, b, 2 * l))(a, b)
+                ref = jax.jit(
+                    lambda a, b: FJ._mul_columns_u32(a, b, 2 * l))(a, b)
+                # column sums differ in representation (f32 path carries
+                # bytes, u32 path carries 16-bit limbs) but the VALUE
+                # (sum of col[k] * 2^16k) must match exactly, per element
+                for j in range(a_np.shape[1]):
+                    gv = sum(int(col[j]) << (16 * k)
+                             for k, col in enumerate(np.asarray(got)))
+                    rv = sum(int(col[j]) << (16 * k)
+                             for k, col in enumerate(np.asarray(ref)))
+                    assert gv == rv, (l, j)
+
+
+def test_mont_mul_extreme_operands():
+    """mont_mul at the largest reduced operands (p-1) in both fields."""
+    for spec, mod in ((FJ.FR, R_MOD), (FJ.FQ, Q_MOD)):
+        xs = [mod - 1, mod - 1, 1, mod - 2]
+        ys = [mod - 1, 1, mod - 1, mod - 2]
+        a = jnp.asarray(ints_to_limbs(xs, spec.n_limbs))
+        b = jnp.asarray(ints_to_limbs(ys, spec.n_limbs))
+
+        @jax.jit
+        def f(a, b):
+            return FJ.from_mont(
+                spec, FJ.mont_mul(spec, FJ.to_mont(spec, a),
+                                  FJ.to_mont(spec, b)))
+
+        assert limbs_to_ints(f(a, b)) == [x * y % mod for x, y in zip(xs, ys)]
