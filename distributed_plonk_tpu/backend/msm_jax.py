@@ -84,12 +84,13 @@ def _group_size(n):
 _PLANE_BYTES_BUDGET = int(os.environ.get("DPT_MSM_PLANE_MB", "1536")) << 20
 
 
-def _group_size_batch(n, batch, c):
+def _group_size_batch(n, batch, c, signed=False):
     """Group width for a B-poly batched MSM: work-optimal size per
     _group_size, further capped so the plane array (which scales with
-    group * B * W * 2^c) stays in budget."""
+    group * B * W * buckets) stays in budget."""
     w = SCALAR_BITS // c
-    per_group = 3 * 4 * FQ_LIMBS * batch * w * (1 << c)
+    buckets = 1 << (c - 1) if signed else 1 << c
+    per_group = 3 * 4 * FQ_LIMBS * batch * w * buckets
     g = _group_size(n)
     while g > 1 and g * per_group > _PLANE_BYTES_BUDGET:
         g //= 2
@@ -134,6 +135,54 @@ def _bucket_scan(px, py, pz, digits, group, n_buckets):
     return bx, by, bz
 
 
+def _bucket_scan_signed(ax, ay, ainf, packed, group):
+    """One window's SIGNED-digit bucket accumulation with mixed adds —
+    the c=8 hot path: half the buckets of the unsigned scan (128 columns,
+    bucket i holds points whose |digit| == i+1; the sign is applied to the
+    point's y on the fly) and madd-2007-bl instead of the full Jacobian add
+    (the base is affine by construction — ark-ec's Pippenger leans on the
+    same two tricks, reference src/worker.rs:122).
+
+    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (n,) uint32
+    = digit + 128 with digit in [-128, 127]. Returns ((24, group, 128),)*3
+    Jacobian bucket planes.
+    """
+    n = ax.shape[1]
+    steps = n // group
+    garange = jnp.arange(group)
+
+    def to_scan(a):  # (24, n) -> (steps, 24, group)
+        return a.reshape(FQ_LIMBS, group, steps).transpose(2, 0, 1)
+
+    def to_scan1(a):  # (n,) -> (steps, group)
+        return a.reshape(group, steps).T
+
+    off = packed.astype(jnp.int32) - 128
+    neg = off < 0
+    mag = jnp.abs(off)
+    skip = (mag == 0) | ainf
+    idx = jnp.maximum(mag, 1).astype(jnp.uint32) - 1  # 0..127
+
+    xs = (to_scan(ax), to_scan(ay), to_scan1(skip), to_scan1(neg),
+          to_scan1(idx))
+
+    vz = ax.ravel()[0] & 0  # varying-zero, see _bucket_scan
+    bx, by, bz = (b + vz for b in CJ.pt_inf((group, 128)))
+
+    def step(carry, x):
+        bx, by, bz = carry
+        sx, sy, sk, ng, dg = x
+        cur = (bx[:, garange, dg], by[:, garange, dg], bz[:, garange, dg])
+        qy = FJ.select(ng, FJ.neg(CJ.FQ, sy), sy)
+        nx, ny, nz = CJ.jac_add_mixed(cur, (sx, qy), sk)
+        return (bx.at[:, garange, dg].set(nx),
+                by.at[:, garange, dg].set(ny),
+                bz.at[:, garange, dg].set(nz)), None
+
+    (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
+    return bx, by, bz
+
+
 def fold_planes(bx, by, bz):
     """(K, 24, W, B) bucket planes -> (24, W, B) bucketwise sum.
 
@@ -157,7 +206,7 @@ def fold_planes(bx, by, bz):
 
 # --- finish tail -------------------------------------------------------------
 
-def finish(bx, by, bz):
+def finish(bx, by, bz, signed=False):
     """(24, W, B) folded buckets -> total point ((24,),)*3.
 
     Three phases, all static-shape scans with NO gather/scatter ops (this
@@ -172,17 +221,21 @@ def finish(bx, by, bz):
          steps on (24, W): `shift=0` steps double the masked windows
          (acc_w ends as 2^(c*w) * A_w), `shift=h` steps add acc[w+h] into
          acc[w] for w < h (pairwise tree); the total lands in lane 0.
+
+    signed=True: planes come from _bucket_scan_signed — B = 2^(c-1)
+    columns where column i weighs (i+1), so phase 1 scans ALL columns
+    (reversed) instead of dropping column 0.
     """
     wins, buckets = bz.shape[1], bz.shape[2]
     c = SCALAR_BITS // wins
-    assert buckets == 1 << c, (wins, buckets)
+    assert buckets == (1 << (c - 1) if signed else 1 << c), (wins, buckets)
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
     inf_w = tuple(x + vz for x in CJ.pt_inf((wins,)))
 
-    # phase 1: bucket columns b = B-1 .. 1, then one infinity flush column
-    def col_xs(a):  # (24, W, B) -> (B, 24, W): columns B-1..1 + inf
-        cols = a[:, :, 1:][:, :, ::-1].transpose(2, 0, 1)
-        return cols
+    # phase 1: bucket columns (weight order), then one infinity flush column
+    def col_xs(a):  # (24, W, B) -> (B, 24, W): high-weight column first
+        body = a if signed else a[:, :, 1:]
+        return body[:, :, ::-1].transpose(2, 0, 1)
 
     xs = tuple(jnp.concatenate([col_xs(a), i[None, :, :]], axis=0)
                for a, i in zip((bx, by, bz), inf_w))
@@ -238,11 +291,23 @@ def bucket_planes_batch(px, py, pz, digits, group):
     return fold_planes(*planes)
 
 
-def finish_batch(acc_x, acc_y, acc_z, batch):
-    """((24, B*W, 2^c),)*3 folded planes -> ((24, B),)*3 totals."""
+def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
+    """Signed-digit analog of bucket_planes_batch: affine bases (24, nc) +
+    inf mask (nc,) + packed digits (B, W, nc) -> ((24, B*W, 2^(c-1)),)*3."""
+    B, W, n = packed.shape
+    flat = packed.reshape(B * W, n)
+    wb = jax.vmap(partial(_bucket_scan_signed, group=group),
+                  in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
+    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)
+    return fold_planes(*planes)
+
+
+def finish_batch(acc_x, acc_y, acc_z, batch, signed=False):
+    """((24, B*W, buckets),)*3 folded planes -> ((24, B),)*3 totals."""
     acc_b = tuple(a.reshape(FQ_LIMBS, batch, a.shape[1] // batch, a.shape[2])
                   for a in (acc_x, acc_y, acc_z))
-    return jax.vmap(finish, in_axes=(1, 1, 1), out_axes=1)(*acc_b)
+    return jax.vmap(partial(finish, signed=signed),
+                    in_axes=(1, 1, 1), out_axes=1)(*acc_b)
 
 
 def msm_pipeline_batch(px, py, pz, digits, group):
@@ -280,8 +345,49 @@ def digits_of_scalars(scalars, padded_n, c):
     return digits.reshape(SCALAR_BITS // c, padded_n)
 
 
+# NOTE on signed-digit safety: recoding carries can only overflow the top
+# window if a scalar's top radix-256 digit can reach 127; Fr scalars are
+# canonical (< r) and r's top byte is 0x73, so the final carry is always 0
+# and 32 windows suffice. (For c < 8 this margin does not exist at every
+# width, so small-window MSMs keep the unsigned path.)
+
+def _signed_recode_np(u):
+    """(32, n) uint32 radix-256 digits -> packed signed digits (d + 128),
+    d in [-128, 127] (host numpy)."""
+    out = np.empty_like(u)
+    carry = np.zeros(u.shape[1], dtype=np.uint32)
+    for w in range(u.shape[0]):
+        t = u[w] + carry
+        carry = (t >= 128).astype(np.uint32)
+        out[w] = t + 128 - (carry << 8)
+    assert not carry.any(), "signed recode overflow (scalar >= 2^255?)"
+    return out
+
+
+def signed_digits_of_scalars(scalars, padded_n):
+    """Host int scalars -> (32, padded_n) packed signed radix-256 digits."""
+    return _signed_recode_np(digits_of_scalars(scalars, padded_n, 8))
+
+
+def signed_digits_from_mont(v, padded_n):
+    """(16, L) Montgomery Fr coefficients -> (32, padded_n) packed signed
+    radix-256 digits, entirely on device (32-step static recode loop)."""
+    u = digits_from_mont(v, 8, padded_n)
+    outs = []
+    carry = jnp.zeros_like(u[0])
+    for w in range(u.shape[0]):
+        t = u[w] + carry
+        carry = (t >= 128).astype(jnp.uint32)
+        outs.append(t + 128 - (carry << 8))
+    return jnp.stack(outs)
+
+
 def points_to_device(bases_affine, pad):
-    """list[(x, y) | None] + pad count -> Jacobian (24, n+pad) Montgomery."""
+    """list[(x, y) | None] + pad count -> affine Montgomery limb arrays
+    ((24, n+pad) x, (24, n+pad) y, (n+pad,) inf mask), as HOST numpy —
+    placement is the caller's call (the mesh context device_puts shards;
+    building on the default device first would bounce every base through
+    whatever chip owns it, round-2 weakness #1)."""
     xs, ys, infs = [], [], []
     for p in bases_affine:
         if p is None:
@@ -295,10 +401,10 @@ def points_to_device(bases_affine, pad):
     xs += [0] * pad
     ys += [0] * pad
     infs += [True] * pad
-    x = jnp.asarray(ints_to_limbs(xs, FQ_LIMBS))
-    y = jnp.asarray(ints_to_limbs(ys, FQ_LIMBS))
-    inf = jnp.asarray(np.array(infs))
-    return CJ.from_affine(x, y, inf)
+    x = ints_to_limbs(xs, FQ_LIMBS)
+    y = ints_to_limbs(ys, FQ_LIMBS)
+    inf = np.array(infs)
+    return x, y, inf
 
 
 class DeviceCommitKey:
@@ -324,21 +430,37 @@ class MsmContext:
         self.n = n
         pad = n % 2  # groups need >= 2 scan steps
         self.padded_n = n + pad
+        self.c = window_bits(self.padded_n)
+        # batched pipelines always use 8-bit windows once the key is big
+        # enough: the bucket planes exactly fill (8, 128) minor tiles, where
+        # a 16-bucket (c=4) plane is layout-padded 8x — the difference
+        # between a 1.2 GB and a 10+ GB program at a batched 2^10 commit
+        self.c_batch = 8 if self.padded_n >= 256 else self.c
+        # c=8 runs the SIGNED pipeline: half the buckets (128 columns,
+        # sign folded into y) and mixed affine adds in the scan — which
+        # needs the bases in affine form (see _bucket_scan_signed)
+        self.signed = self.c_batch == 8
         if isinstance(bases, DeviceCommitKey):
             point = bases.point
             if pad:
                 point = tuple(jnp.pad(p, ((0, 0), (0, pad))) for p in point)
-            self.point = point
+            if self.signed:
+                # device-built SRS is Jacobian with arbitrary Z: normalize
+                # once with a batched inversion (one scalar host round-trip)
+                self.point = CJ.batch_to_affine(point)
+            else:
+                self.point = point
         else:
-            self.point = points_to_device(bases, pad)
-        self.c = window_bits(self.padded_n)
-        # batched pipelines always use 8-bit windows once the key is big
-        # enough: 2^8 buckets exactly fill the (8, 128) minor tile, where a
-        # 16-bucket (c=4) plane is layout-padded 8x — the difference between
-        # a 1.2 GB and a 10+ GB program at a batched 2^10 commit
-        self.c_batch = 8 if self.padded_n >= 256 else self.c
-        self._digits_batch_fn = jax.jit(
-            partial(digits_from_mont, c=self.c_batch, padded_n=self.padded_n))
+            ax, ay, ainf = points_to_device(bases, pad)
+            self.point = (ax, ay, ainf) if self.signed \
+                else CJ.from_affine(ax, ay, ainf)
+        if self.signed:
+            self._digits_batch_fn = jax.jit(
+                partial(signed_digits_from_mont, padded_n=self.padded_n))
+        else:
+            self._digits_batch_fn = jax.jit(
+                partial(digits_from_mont, c=self.c_batch,
+                        padded_n=self.padded_n))
         self._chunk_fns = {}
         self._finish_fns = {}
         self._merge_fn = jax.jit(
@@ -353,14 +475,15 @@ class MsmContext:
     def _chunk_fn(self, nc, group):
         key = (nc, group)
         if key not in self._chunk_fns:
-            self._chunk_fns[key] = jax.jit(
-                partial(bucket_planes_batch, group=group))
+            fn = bucket_planes_batch_signed if self.signed \
+                else bucket_planes_batch
+            self._chunk_fns[key] = jax.jit(partial(fn, group=group))
         return self._chunk_fns[key]
 
     def _finish_fn(self, batch):
         if batch not in self._finish_fns:
             self._finish_fns[batch] = jax.jit(
-                partial(finish_batch, batch=batch))
+                partial(finish_batch, batch=batch, signed=self.signed))
         return self._finish_fns[batch]
 
     def _exec_chunked(self, digits):
@@ -369,13 +492,14 @@ class MsmContext:
         accumulation, cheap cross-chunk plane merges, one finish tail."""
         B, W, n = digits.shape
         chunk = max(1024, (self._CALL_ADDS // (B * W)) & ~1023)
-        px, py, pz = self.point
+        pa, pb, pc = self.point  # (x, y, inf) signed / (x, y, z) unsigned
         acc = None
         for i0 in range(0, n, chunk):
             nc = min(chunk, n - i0)
-            g = _group_size_batch(nc, B, SCALAR_BITS // W)
+            g = _group_size_batch(nc, B, SCALAR_BITS // W, signed=self.signed)
             part = self._chunk_fn(nc, g)(
-                px[:, i0:i0 + nc], py[:, i0:i0 + nc], pz[:, i0:i0 + nc],
+                pa[:, i0:i0 + nc], pb[:, i0:i0 + nc],
+                pc[i0:i0 + nc] if self.signed else pc[:, i0:i0 + nc],
                 digits[:, :, i0:i0 + nc])
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
         return self._finish_fn(B)(*acc)
@@ -418,10 +542,13 @@ class MsmContext:
 
     def msm_many(self, scalar_lists):
         """B MSMs over host int scalar lists in batched launches."""
-        return self._run_batches(
-            scalar_lists,
-            lambda s: jnp.asarray(
-                digits_of_scalars(s, self.padded_n, self.c_batch)))
+        if self.signed:
+            make = lambda s: jnp.asarray(
+                signed_digits_of_scalars(s, self.padded_n))
+        else:
+            make = lambda s: jnp.asarray(
+                digits_of_scalars(s, self.padded_n, self.c_batch))
+        return self._run_batches(scalar_lists, make)
 
 
 def _jac_limbs_to_affine(tx, ty, tz):

@@ -29,6 +29,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..backend import msm_jax
+from ..backend import curve_jax as CJ
 from .mesh import SHARD_AXIS
 
 
@@ -51,9 +52,14 @@ class MeshMsmContext:
         # device's bucket pipeline actually sees)
         self.c = msm_jax.window_bits(self.local_n)
 
-        point = msm_jax.points_to_device(bases_affine, pad)
+        # the mesh scan keeps the unsigned Jacobian pipeline (tiny dry-run
+        # shapes use c < 8 where the signed recode has no overflow margin);
+        # Z is built on HOST so the only device traffic is the sharded put
+        ax, ay, ainf = msm_jax.points_to_device(bases_affine, pad)
+        z = np.where(ainf[None, :], 0,
+                     np.asarray(CJ._MONT_ONE)[:, None]).astype(np.uint32)
         shard_nd = jax.sharding.NamedSharding(mesh, P(None, SHARD_AXIS))
-        self.point = tuple(jax.device_put(c, shard_nd) for c in point)
+        self.point = tuple(jax.device_put(c, shard_nd) for c in (ax, ay, z))
 
         shard = P(None, SHARD_AXIS)
 
@@ -90,8 +96,12 @@ class MeshMsmContext:
         buckets = self._fn(px, py, pz, digits)
         # commit the replicated fold result to ONE device: otherwise the
         # finish jit inherits the 8-way replicated sharding and every
-        # device redundantly executes the whole tail
-        dev = self.mesh.devices.ravel()[0]
+        # device redundantly executes the whole tail. Under multi-controller
+        # the device must be LOCAL to this process (each process runs the
+        # tail on its own replica; results are identical by construction).
+        dev = next((d for d in self.mesh.devices.ravel()
+                    if d.process_index == jax.process_index()),
+                   self.mesh.devices.ravel()[0])
         buckets = tuple(jax.device_put(b, dev) for b in buckets)
         tx, ty, tz = self._finish(*buckets)
         return msm_jax._jac_limbs_to_affine(tx, ty, tz)
