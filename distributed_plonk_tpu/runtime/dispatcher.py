@@ -10,9 +10,12 @@ SURVEY.md §2.3.1).
 """
 
 import concurrent.futures as futures
+import os
 import random
 import struct
 import threading
+
+import numpy as np
 
 from . import native, protocol
 from .. import curve as C
@@ -28,15 +31,41 @@ def _split_rc(n):
 
 
 class WorkerHandle:
+    """One framed connection to a worker, with a per-call timeout and one
+    reconnect-retry — the failure handling the reference never had (every
+    RPC there is .unwrap(), SURVEY.md §5: a worker crash hangs the prove).
+
+    A timeout mid-frame desynchronizes the stream, so recovery is always
+    reconnect-then-retry, never resend on the same socket. Retried requests
+    are idempotent at the worker (MSM/NTT are pure; FFT1/FFT_EXCHANGE
+    overwrite the same slots; FFT2 replays its cached reply instead of
+    deleting the task — completed tasks are GC'd by age)."""
+
+    # 0 = block forever; FFT2 on a python-backend worker can take minutes
+    TIMEOUT_MS = int(os.environ.get("DPT_CALL_TIMEOUT_MS", "600000"))
+
     def __init__(self, host, port):
-        self.conn = native.connect(host, port)
+        self.host, self.port = host, port
+        self.conn = self._connect()
         # one in-flight request per connection: frames are not interleavable
         self._lock = threading.Lock()
 
+    def _connect(self):
+        conn = native.connect(self.host, self.port)
+        if self.TIMEOUT_MS:
+            conn.set_timeout(self.TIMEOUT_MS)
+        return conn
+
     def call(self, tag, payload=b""):
         with self._lock:
-            self.conn.send(tag, payload)
-            rtag, rpayload = self.conn.recv()
+            try:
+                self.conn.send(tag, payload)
+                rtag, rpayload = self.conn.recv()
+            except (ConnectionError, OSError):
+                self.conn.close()
+                self.conn = self._connect()  # one retry on a fresh stream
+                self.conn.send(tag, payload)
+                rtag, rpayload = self.conn.recv()
         if rtag != protocol.OK:
             raise RuntimeError(f"worker error: {rpayload!r}")
         return rpayload
@@ -109,7 +138,13 @@ class Dispatcher:
         """ONE cross-worker sharded 4-step (i)(coset)FFT — the reference's
         hot protocol (Prover::fft, dispatcher2.rs:731-787): stage-1 rows
         scattered block-wise, direct worker<->worker all-to-all, stage-2
-        columns gathered. len(values) must be a power of two."""
+        columns gathered. len(values) must be a power of two.
+
+        Host data plane is a (16, n) numpy limb matrix end to end: the
+        row/column restrides are numpy views and every wire payload is one
+        bulk codec call (the per-int Python path was round-2 weakness #8;
+        the reference's analog is ip_transpose around scatter/gather,
+        src/dispatcher.rs:305,332)."""
         n = len(values)
         assert n >= 4 and n & (n - 1) == 0, n
         r, c = _split_rc(n)
@@ -117,6 +152,10 @@ class Dispatcher:
         task_id = random.getrandbits(63)
         row_bounds = [c * i // k for i in range(k + 1)]
         col_ranges = [(r * i // k, r * (i + 1) // k) for i in range(k)]
+
+        # (16, c, r): axis 1 = row index j2 (stride c in the flat poly)
+        vm = protocol.ints_to_matrix(values).reshape(16, r, c)
+        rows_mat = vm.transpose(0, 2, 1)  # [16, j2, position-in-row]
 
         list(self.pool.map(
             lambda i: self.workers[i].call(
@@ -129,9 +168,9 @@ class Dispatcher:
             rs, re = row_bounds[i], row_bounds[i + 1]
             if re == rs:
                 return
-            rows = [values[j2::c] for j2 in range(rs, re)]
+            panel = np.ascontiguousarray(rows_mat[:, rs:re, :])
             self.workers[i].call(
-                protocol.FFT1, protocol.encode_fft1(task_id, rs, rows))
+                protocol.FFT1, protocol.encode_fft1_matrix(task_id, rs, panel))
 
         list(self.pool.map(scatter, range(k)))
 
@@ -142,16 +181,17 @@ class Dispatcher:
             range(k)))
 
         def gather(i):
-            return protocol.decode_scalars(self.workers[i].call(
+            return protocol.decode_scalar_matrix(self.workers[i].call(
                 protocol.FFT2, struct.pack("<Q", task_id)))
 
-        out = [0] * n
+        out = np.empty((16, r, c), dtype=np.uint32)  # [16, k1, k2]
         for i, flat in enumerate(self.pool.map(gather, range(k))):
             cs, ce = col_ranges[i]
-            for local, k1 in enumerate(range(cs, ce)):
-                row = flat[local * c:(local + 1) * c]
-                out[k1::r] = row
-        return out
+            if ce > cs:
+                out[:, cs:ce, :] = flat.reshape(16, ce - cs, c)
+        # result index is k1 + r*k2 -> transpose to [k2, k1] before flatten
+        return protocol.matrix_to_ints(
+            np.ascontiguousarray(out.transpose(0, 2, 1)).reshape(16, n))
 
     def stats(self):
         """Per-worker served-request counters {tag: count}."""

@@ -53,6 +53,7 @@ def lib():
             ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint32)]
         L.dpt_recv_payload.argtypes = [ctypes.c_int, u8p, ctypes.c_uint64]
+        L.dpt_set_timeout.argtypes = [ctypes.c_int, ctypes.c_int]
         L.dpt_close.argtypes = [ctypes.c_int]
     return _lib
 
@@ -123,6 +124,13 @@ class Conn:
                                                    length.value) != 0:
             raise ConnectionError("recv payload failed")
         return tag.value, buf.tobytes()
+
+    def set_timeout(self, ms):
+        """Socket send/recv timeout. A timeout mid-frame desynchronizes the
+        stream, so callers must reconnect after one fires (WorkerHandle
+        does)."""
+        if lib().dpt_set_timeout(self.fd, int(ms)) != 0:
+            raise OSError("set_timeout failed")
 
     def close(self):
         if self.fd >= 0:

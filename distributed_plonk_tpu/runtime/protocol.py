@@ -12,7 +12,10 @@ are x(48B LE) || y(48B LE) || inf(u8).
 
 import struct
 
+import numpy as np
+
 from ..constants import R_MOD
+from . import native
 
 # tags
 PING = 1
@@ -28,7 +31,8 @@ FFT_INIT = 6       # u64 id, u8 flags, u64 n/r/c, u64 rs/re/cs/ce -> OK
 FFT1 = 7           # u64 id, u64 first_row, u64 count, count*r*32B -> OK
 FFT2_PREPARE = 8   # u64 id -> OK once all peer exchanges are acknowledged
 FFT_EXCHANGE = 9   # worker->worker: u64 id, u64 col_start, u64 col_count,
-                   # u64 n_rows, then per row: u64 j2, col_count*32B -> OK
+                   # u64 row_start, u64 row_count, then a contiguous
+                   # (row_count x col_count) panel of 32B scalars -> OK
 FFT2 = 10          # u64 id -> reply (ce-cs)*c_len*32B stage-2 rows + task GC
 STATS = 11         # -> reply JSON {tag: count} served-request counters
 OK = 100
@@ -47,6 +51,36 @@ def decode_scalars(raw):
     n = len(raw) // FR_BYTES
     return [int.from_bytes(raw[i * FR_BYTES:(i + 1) * FR_BYTES], "little")
             for i in range(n)]
+
+
+# --- bulk limb-matrix codecs (hot data plane) --------------------------------
+# Same wire bytes as encode_scalars/decode_scalars (concatenated 32B LE
+# elements), but host-side data stays a (16, n) uint32 limb matrix converted
+# by the native C++ codec in ONE call — no per-int Python serialization
+# (round-2 weakness #8: the pure-Python plane was the 2^18 bottleneck; the
+# reference's analog is its zero-copy transmute, src/utils.rs:27-43).
+
+def encode_scalar_matrix(limbs):
+    """(16, n) uint32 16-bit-limb matrix -> wire bytes."""
+    return native.limbs_to_bytes(np.ascontiguousarray(limbs))
+
+
+def decode_scalar_matrix(raw):
+    """Wire bytes -> (16, n) uint32 limb matrix."""
+    n = len(raw) // FR_BYTES
+    return native.bytes_to_limbs(raw, n, FR_BYTES)
+
+
+def ints_to_matrix(scalars):
+    """Host int list -> (16, n) limb matrix (one C-level pass)."""
+    from ..backend.limbs import ints_to_limbs
+    return ints_to_limbs([s % R_MOD for s in scalars], FR_BYTES // 2)
+
+
+def matrix_to_ints(limbs):
+    """(16, n) limb matrix -> host int list (one C-level pass)."""
+    from ..backend.limbs import limbs_to_ints
+    return limbs_to_ints(limbs)
 
 
 def encode_point(p):
@@ -96,39 +130,40 @@ def decode_fft_init(raw):
             col_ranges)
 
 
-def encode_fft1(task_id, first_row, rows):
-    return (struct.pack("<QQQ", task_id, first_row, len(rows))
-            + b"".join(encode_scalars(r) for r in rows))
+def encode_fft1_matrix(task_id, first_row, panel):
+    """panel: (16, count, row_len) limb array; wire format: u64 id, u64
+    first_row, u64 count, then count rows of row_len 32B LE scalars."""
+    count = panel.shape[1]
+    return (struct.pack("<QQQ", task_id, first_row, count)
+            + encode_scalar_matrix(panel.reshape(16, count * panel.shape[2])))
 
 
-def decode_fft1(raw):
+def decode_fft1_matrix(raw):
+    """-> (task_id, first_row, (16, count, row_len) limbs)"""
     task_id, first_row, count = struct.unpack_from("<QQQ", raw, 0)
-    body = raw[24:]
-    row_len = len(body) // count // FR_BYTES if count else 0
-    rows = [decode_scalars(body[i * row_len * FR_BYTES:(i + 1) * row_len * FR_BYTES])
-            for i in range(count)]
-    return task_id, first_row, rows
+    m = decode_scalar_matrix(raw[24:])
+    row_len = m.shape[1] // count if count else 0
+    return task_id, first_row, m.reshape(16, count, row_len)
 
 
-def encode_fft_exchange(task_id, col_start, col_count, entries):
-    """entries: [(j2, values[col_count])]"""
-    head = struct.pack("<QQQQ", task_id, col_start, col_count, len(entries))
-    body = b"".join(struct.pack("<Q", j2) + encode_scalars(vals)
-                    for j2, vals in entries)
-    return head + body
+def encode_fft_exchange(task_id, col_start, col_count, row_start, panel):
+    """panel: (16, row_count, col_count) uint32 limb array — the sender's
+    CONTIGUOUS stage-1 row block sliced to one peer's column range, shipped
+    as one limb-matrix codec call (the per-row int-list format of round 2
+    was the fleet's serialization bottleneck)."""
+    row_count = panel.shape[1]
+    head = struct.pack("<QQQQQ", task_id, col_start, col_count, row_start,
+                       row_count)
+    return head + encode_scalar_matrix(panel.reshape(16, row_count * col_count))
 
 
 def decode_fft_exchange(raw):
-    task_id, col_start, col_count, n_rows = struct.unpack_from("<QQQQ", raw, 0)
-    off = 32
-    stride = 8 + col_count * FR_BYTES
-    entries = []
-    for _ in range(n_rows):
-        (j2,) = struct.unpack_from("<Q", raw, off)
-        vals = decode_scalars(raw[off + 8:off + stride])
-        entries.append((j2, vals))
-        off += stride
-    return task_id, col_start, col_count, entries
+    """-> (task_id, col_start, col_count, row_start, (16, rows, cols) limbs)"""
+    task_id, col_start, col_count, row_start, row_count = \
+        struct.unpack_from("<QQQQQ", raw, 0)
+    m = decode_scalar_matrix(raw[40:])
+    return (task_id, col_start, col_count, row_start,
+            m.reshape(16, row_count, col_count))
 
 
 def encode_ntt_request(values, inverse, coset):

@@ -21,9 +21,13 @@ Run: python -m distributed_plonk_tpu.runtime.worker <index> [config.json]
     [--backend python|jax]
 """
 
+import os
 import struct
 import sys
 import threading
+import time
+
+import numpy as np
 
 from . import native, protocol
 from .netconfig import NetworkConfig
@@ -43,7 +47,11 @@ def _make_backend(name):
 class FftTask:
     """In-flight sharded FFT state (the reference's FftTask,
     /root/reference/src/worker.rs:50-54): stage-1 results for our rows,
-    stage-2 input columns filled in by peer exchanges."""
+    stage-2 input columns filled in by peer exchanges.
+
+    Data plane is numpy limb matrices end to end (exchange panels land with
+    one slice assignment); `created` supports age-based GC, fixing the
+    reference's task leak on dispatcher abort (worker.rs:378)."""
 
     def __init__(self, inverse, coset, n, r, c, rs, re, col_ranges, me):
         self.inverse = inverse
@@ -52,8 +60,21 @@ class FftTask:
         self.rs, self.re = rs, re          # our stage-1 rows (j2 indices)
         self.col_ranges = col_ranges       # every worker's stage-2 range (k1)
         self.cs, self.ce = col_ranges[me]
-        self.rows = [None] * (re - rs)     # [local j2] -> length-r row
-        self.cols = [[None] * c for _ in range(self.ce - self.cs)]  # [local k1][j2]
+        self.rows = [None] * (re - rs)     # [local j2] -> length-r row (ints)
+        # [16, local k1, j2] stage-2 input columns; fill_mask tracks exchange
+        # completeness per (column, row) cell — a REGION mask, not a counter,
+        # so a retried FFT2_PREPARE (same panels re-pushed after a dispatcher
+        # reconnect) stays idempotent
+        self.cols = np.zeros((16, self.ce - self.cs, c), dtype=np.uint32)
+        self.fill_mask = np.zeros((self.ce - self.cs, c), dtype=bool)
+        self.cols_lock = threading.Lock()
+        self.created = time.monotonic()
+        # FFT2 caches its reply here instead of deleting the task, so a
+        # dispatcher retry (reconnect after timeout) gets the same bytes
+        # back — FFT2 is idempotent like every other request; completed
+        # tasks are GC'd by age at the next FFT_INIT
+        self.result = None
+        self.done_at = None
 
 
 class WorkerState:
@@ -153,53 +174,79 @@ def handle(conn, state):
             return False
 
 
+# abandoned FFT tasks (dispatcher died mid-protocol) are purged when older
+# than this; COMPLETED tasks (kept only so FFT2 retries can re-read their
+# reply) are purged much sooner; both checked on every FFT_INIT
+_FFT_TASK_TTL_S = float(os.environ.get("DPT_FFT_TASK_TTL", "600"))
+_FFT_DONE_TTL_S = float(os.environ.get("DPT_FFT_DONE_TTL", "60"))
+
+
 def _dispatch(conn, state, tag, payload):
     """Handle one request frame. Returns False to stop the daemon, anything
-    else to keep serving."""
+    else to keep serving.
+
+    Locking: state.lock guards only STATE lookups/mutations (bases ref,
+    domain/task tables); kernel execution happens OUTSIDE it, so one worker
+    can overlap compute for concurrent connections (round-2 weakness #9
+    serialized the whole MSM under the lock)."""
     state.count(tag)
     if tag == protocol.PING:
         conn.send(protocol.OK)
     elif tag == protocol.INIT_BASES:
+        bases = protocol.decode_points(payload)
         with state.lock:
-            state.bases = protocol.decode_points(payload)
+            state.bases = bases
         conn.send(protocol.OK)
     elif tag == protocol.MSM:
         scalars = protocol.decode_scalars(payload)
         with state.lock:
-            if state.bases is None:
-                conn.send(protocol.ERR, b"no bases")
-                return None
-            result = state.backend.msm(state.bases, scalars)
+            bases = state.bases
+        if bases is None:
+            conn.send(protocol.ERR, b"no bases")
+            return None
+        result = state.backend.msm(bases, scalars)
         conn.send(protocol.OK, protocol.encode_point(result))
     elif tag == protocol.NTT:
         values, inverse, coset = protocol.decode_ntt_request(payload)
         with state.lock:
             domain = state.domain(len(values))
-            if inverse and coset:
-                out = state.backend.coset_ifft(domain, values)
-            elif inverse:
-                out = state.backend.ifft(domain, values)
-            elif coset:
-                out = state.backend.coset_fft(domain, values)
-            else:
-                out = state.backend.fft(domain, values)
-        conn.send(protocol.OK, protocol.encode_scalars(out))
+        if inverse and coset:
+            out = state.backend.coset_ifft(domain, values)
+        elif inverse:
+            out = state.backend.ifft(domain, values)
+        elif coset:
+            out = state.backend.coset_fft(domain, values)
+        else:
+            out = state.backend.fft(domain, values)
+        conn.send(protocol.OK,
+                  protocol.encode_scalar_matrix(protocol.ints_to_matrix(out)))
     elif tag == protocol.FFT_INIT:
         (task_id, inverse, coset, n, r, c, rs, re,
          col_ranges) = protocol.decode_fft_init(payload)
+        now = time.monotonic()
         with state.lock:
+            stale = [tid for tid, t in state.fft_tasks.items()
+                     if (now - t.created > _FFT_TASK_TTL_S
+                         or (t.done_at is not None
+                             and now - t.done_at > _FFT_DONE_TTL_S))]
+            for tid in stale:
+                del state.fft_tasks[tid]
             state.fft_tasks[task_id] = FftTask(
                 inverse, coset, n, r, c, rs, re, col_ranges, state.me)
         conn.send(protocol.OK)
     elif tag == protocol.FFT1:
-        task_id, first_row, rows = protocol.decode_fft1(payload)
+        task_id, first_row, panel = protocol.decode_fft1_matrix(payload)
         with state.lock:
             task = state.fft_tasks[task_id]
-        domain_r = state.domain(task.r)
-        for off, row in enumerate(rows):
+            domain_r = state.domain(task.r)
+        count = panel.shape[1]
+        ints = protocol.matrix_to_ints(panel.reshape(16, count * panel.shape[2]))
+        row_len = panel.shape[2]
+        for off in range(count):
             j2 = first_row + off
             task.rows[j2 - task.rs] = _stage1_row(
-                state.backend, domain_r, task, j2, row)
+                state.backend, domain_r, task, j2,
+                ints[off * row_len:(off + 1) * row_len])
         conn.send(protocol.OK)
     elif tag == protocol.FFT2_PREPARE:
         (task_id,) = struct.unpack_from("<Q", payload, 0)
@@ -207,44 +254,57 @@ def _dispatch(conn, state, tag, payload):
             task = state.fft_tasks[task_id]
         # push every peer its column slice of our rows (the all-to-all,
         # worker.rs:280-345); each send waits for the peer's ACK, so our OK
-        # to the dispatcher implies all our data has landed
-        for p, (ps, pe) in enumerate(task.col_ranges):
-            if pe == ps or task.re == task.rs:
-                continue
-            entries = [(j2, task.rows[j2 - task.rs][ps:pe])
-                       for j2 in range(task.rs, task.re)]
-            pconn, plock = state.peer(p)
-            with plock:
-                pconn.send(protocol.FFT_EXCHANGE, protocol.encode_fft_exchange(
-                    task_id, ps, pe - ps, entries))
-                rtag, rpayload = pconn.recv()
-            if rtag != protocol.OK:
-                raise RuntimeError(f"peer {p} exchange failed: {rpayload!r}")
+        # to the dispatcher implies all our data has landed. Rows go out as
+        # ONE contiguous limb panel per peer (bulk codec, no per-row lists).
+        if task.re > task.rs:
+            flat = [v for j2 in range(task.rs, task.re)
+                    for v in task.rows[j2 - task.rs]]
+            rows_np = protocol.ints_to_matrix(flat).reshape(
+                16, task.re - task.rs, task.r)
+            for p, (ps, pe) in enumerate(task.col_ranges):
+                if pe == ps:
+                    continue
+                panel = np.ascontiguousarray(rows_np[:, :, ps:pe])
+                pconn, plock = state.peer(p)
+                with plock:
+                    pconn.send(protocol.FFT_EXCHANGE,
+                               protocol.encode_fft_exchange(
+                                   task_id, ps, pe - ps, task.rs, panel))
+                    rtag, rpayload = pconn.recv()
+                if rtag != protocol.OK:
+                    raise RuntimeError(f"peer {p} exchange failed: {rpayload!r}")
         conn.send(protocol.OK)
     elif tag == protocol.FFT_EXCHANGE:
-        task_id, col_start, col_count, entries = \
+        task_id, col_start, col_count, row_start, panel = \
             protocol.decode_fft_exchange(payload)
         with state.lock:
             task = state.fft_tasks[task_id]
-        for j2, vals in entries:
-            for i in range(col_count):
-                task.cols[col_start + i - task.cs][j2] = vals[i]
+        lo = col_start - task.cs
+        with task.cols_lock:
+            task.cols[:, lo:lo + col_count,
+                      row_start:row_start + panel.shape[1]] = \
+                panel.transpose(0, 2, 1)
+            task.fill_mask[lo:lo + col_count,
+                           row_start:row_start + panel.shape[1]] = True
         conn.send(protocol.OK)
     elif tag == protocol.FFT2:
         (task_id,) = struct.unpack_from("<Q", payload, 0)
         with state.lock:
             task = state.fft_tasks[task_id]
-        domain_c = state.domain(task.c)
-        out = []
-        for local, k1 in enumerate(range(task.cs, task.ce)):
-            row = task.cols[local]
-            assert None not in row, f"fft2 before exchange complete (k1={k1})"
-            out.extend(_stage2_row(state.backend, domain_c, task, k1, row))
-        with state.lock:
-            del state.fft_tasks[task_id]  # GC (the reference leaks on abort
-            # too, worker.rs:378; dispatcher failure mid-task leaves the
-            # entry until process restart)
-        conn.send(protocol.OK, protocol.encode_scalars(out))
+            domain_c = state.domain(task.c)
+        if task.result is None:
+            assert task.fill_mask.all(), \
+                f"fft2 before exchange complete ({task.fill_mask.sum()}" \
+                f"/{task.fill_mask.size})"
+            out = []
+            for local, k1 in enumerate(range(task.cs, task.ce)):
+                row = protocol.matrix_to_ints(task.cols[:, local, :])
+                out.extend(_stage2_row(state.backend, domain_c, task, k1, row))
+            # reply rides the bulk codec (wire-identical to encode_scalars)
+            task.result = protocol.encode_scalar_matrix(
+                protocol.ints_to_matrix(out))
+            task.done_at = time.monotonic()
+        conn.send(protocol.OK, task.result)
     elif tag == protocol.STATS:
         import json as _json
         with state.lock:

@@ -24,6 +24,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 extern "C" {
@@ -168,6 +169,18 @@ int dpt_recv_header(int fd, uint64_t* len, uint32_t* tag) {
 // read the payload announced by dpt_recv_header into caller buffer
 int dpt_recv_payload(int fd, uint8_t* buf, uint64_t len) {
     return read_exact(fd, buf, len);
+}
+
+// receive/send timeout in milliseconds (0 = blocking forever); after a
+// timeout fires mid-frame the stream is unsynchronized, so callers must
+// treat it as fatal for the connection (reconnect) — returns 0 / -1
+int dpt_set_timeout(int fd, int ms) {
+    timeval tv;
+    tv.tv_sec = ms / 1000;
+    tv.tv_usec = (ms % 1000) * 1000;
+    if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) != 0) return -1;
+    if (setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) != 0) return -1;
+    return 0;
 }
 
 int dpt_close(int fd) { return close(fd); }
