@@ -43,10 +43,16 @@ class JaxBackend:
     name = "jax"
 
     def __init__(self):
+        import threading
         self._msm_ctxs = {}
         self._circuit_tabs = {}
         self._pk_polys = {}
         self._domain_tabs = {}
+        # guards check-then-insert on the capped caches: the worker daemon
+        # runs kernels outside its state lock, so two connections can hit a
+        # backend cache concurrently (an eviction between check and read
+        # would KeyError)
+        self._cache_lock = threading.Lock()
         # host-boundary transfer counters (asserted on in tests: mid-prove
         # traffic must be scalars only)
         self.lifts = 0
@@ -68,11 +74,24 @@ class JaxBackend:
 
     def _ctx(self, bases):
         # keyed by identity; the bases reference is retained so the id can
-        # never be recycled by a different object while cached
+        # never be recycled by a different object while cached. Capped like
+        # the other device caches: an uncapped map keyed by commit keys
+        # retains every SRS's Jacobian arrays forever (HBM leak in a
+        # long-lived worker process serving many circuits).
+        # Double-checked: the EXPENSIVE build (MsmContext runs a batched
+        # affine normalization at SRS scale) happens outside the lock so
+        # concurrent cache hits never wait on it; a lost race costs one
+        # duplicate build, not correctness.
         key = id(bases)
-        if key not in self._msm_ctxs:
-            self._msm_ctxs[key] = (bases, MsmContext(bases))
-        return self._msm_ctxs[key][1]
+        with self._cache_lock:
+            hit = self._msm_ctxs.get(key)
+        if hit is None:
+            built = MsmContext(bases)
+            with self._cache_lock:
+                if key not in self._msm_ctxs:
+                    self._cache_put(self._msm_ctxs, key, (bases, built))
+                hit = self._msm_ctxs[key]
+        return hit[1]
 
     def msm(self, bases, scalars):
         """Variable-base MSM; scalars zero-padded to |bases| on device."""
@@ -90,6 +109,17 @@ class JaxBackend:
     def lift(self, values):
         self.lifts += 1
         return jnp.asarray(PJ.lift(values))
+
+    def lift_many(self, value_lists):
+        """Upload B equal-length int lists as ONE transfer -> B handles
+        (preprocess lifts its 18 selector/sigma columns this way: one
+        tunnel round-trip instead of 18)."""
+        n = len(value_lists[0])
+        assert all(len(v) == n for v in value_lists)
+        flat = [x for vs in value_lists for x in vs]
+        self.lifts += 1
+        h = jnp.asarray(PJ.lift(flat))
+        return [h[:, i * n:(i + 1) * n] for i in range(len(value_lists))]
 
     def lower(self, h):
         self.lowers += 1
@@ -109,13 +139,24 @@ class JaxBackend:
 
     def pk_polys(self, pk):
         key = id(pk)
-        if key not in self._pk_polys:
+        with self._cache_lock:
+            hit = self._pk_polys.get(key)
+        if hit is None:
             self.lifts += 1  # O(n) upload: proving-key polys, once per pk
             sel = [jnp.asarray(PJ.lift(s)) for s in pk.selectors]
             sig = [jnp.asarray(PJ.lift(s)) for s in pk.sigmas]
-            self._cache_put(self._pk_polys, key, (pk, sel, sig))
-        _, sel, sig = self._pk_polys[key]
-        return sel, sig
+            with self._cache_lock:
+                if key not in self._pk_polys:
+                    self._cache_put(self._pk_polys, key, (pk, sel, sig))
+                hit = self._pk_polys[key]
+        return hit[1], hit[2]
+
+    def register_pk_polys(self, pk, sel_h, sig_h):
+        """Seed the pk-poly cache with handles preprocess just computed on
+        device, so the prover never lowers+re-lifts 18 selector/sigma
+        polynomials through the host (kzg.preprocess batched path)."""
+        with self._cache_lock:
+            self._cache_put(self._pk_polys, id(pk), (pk, list(sel_h), list(sig_h)))
 
     def _kernel(self, domain, h, inverse, coset):
         plan = ntt_jax.get_plan(domain.size)
@@ -215,25 +256,33 @@ class JaxBackend:
         """Per-circuit device tables: witness wires, identity-permutation
         values, and sigma-mapped identity values — lifted once."""
         key = id(circuit)
-        if key not in self._circuit_tabs:
-            self.lifts += 1  # O(n) upload: witness + permutation tables
-            n = len(circuit.wire_variables[0])
-            w = NUM_WIRE_TYPES
-            wire_vals = [circuit.wire_values(i) for i in range(w)]
-            flat = [v for vals in wire_vals for v in vals]
-            wires = jnp.asarray(PJ.lift(flat)).reshape(FR_LIMBS, w, n)
-            id_flat = [circuit.extended_id_permutation[i][j]
-                       for i in range(w) for j in range(n)]
-            id_tab = jnp.asarray(PJ.lift(id_flat)).reshape(FR_LIMBS, w, n)
-            sig_flat = []
-            for i in range(w):
-                for j in range(n):
-                    pi, pj = circuit.wire_permutation[i][j]
-                    sig_flat.append(circuit.extended_id_permutation[pi][pj])
-            sig_tab = jnp.asarray(PJ.lift(sig_flat)).reshape(FR_LIMBS, w, n)
-            self._cache_put(self._circuit_tabs, key, (circuit, {
-                "wires": wires, "id": id_tab, "sig": sig_tab, "n": n}))
-        return self._circuit_tabs[key][1]
+        with self._cache_lock:
+            hit = self._circuit_tabs.get(key)
+        if hit is not None:
+            return hit[1]
+        tabs = self._build_circuit_tables(circuit)
+        with self._cache_lock:
+            if key not in self._circuit_tabs:
+                self._cache_put(self._circuit_tabs, key, (circuit, tabs))
+            return self._circuit_tabs[key][1]
+
+    def _build_circuit_tables(self, circuit):
+        self.lifts += 1  # O(n) upload: witness + permutation tables
+        n = len(circuit.wire_variables[0])
+        w = NUM_WIRE_TYPES
+        wire_vals = [circuit.wire_values(i) for i in range(w)]
+        flat = [v for vals in wire_vals for v in vals]
+        wires = jnp.asarray(PJ.lift(flat)).reshape(FR_LIMBS, w, n)
+        id_flat = [circuit.extended_id_permutation[i][j]
+                   for i in range(w) for j in range(n)]
+        id_tab = jnp.asarray(PJ.lift(id_flat)).reshape(FR_LIMBS, w, n)
+        sig_flat = []
+        for i in range(w):
+            for j in range(n):
+                pi, pj = circuit.wire_permutation[i][j]
+                sig_flat.append(circuit.extended_id_permutation[pi][pj])
+        sig_tab = jnp.asarray(PJ.lift(sig_flat)).reshape(FR_LIMBS, w, n)
+        return {"wires": wires, "id": id_tab, "sig": sig_tab, "n": n}
 
     def perm_product(self, circuit, beta, gamma, n):
         tabs = self._circuit_tables(circuit)
@@ -245,10 +294,11 @@ class JaxBackend:
 
     def _domain_tables(self, m, n, group_gen):
         key = (m, n)
-        if key not in self._domain_tabs:
-            self._domain_tabs[key] = PJ.domain_tables_jit(
-                m, n, FR_GENERATOR, group_gen)
-        return self._domain_tabs[key]
+        with self._cache_lock:
+            if key not in self._domain_tabs:
+                self._domain_tabs[key] = PJ.domain_tables_jit(
+                    m, n, FR_GENERATOR, group_gen)
+            return self._domain_tabs[key]
 
     def quotient(self, n, m, quot_domain, k, beta, gamma, alpha, alpha_sq_div_n,
                  selectors_coset, sigmas_coset, wires_coset, z_coset, pi_coset):

@@ -35,12 +35,37 @@ class VerifyingKey:
 
 
 class ProvingKey:
-    def __init__(self, ck, selectors, sigmas, vk, domain):
-        self.ck = ck                # commit key: G1 powers, padded
-        self.selectors = selectors  # 13 coefficient vectors
-        self.sigmas = sigmas        # 5 coefficient vectors
+    """ck: commit key (G1 powers, padded); selectors: 13 coefficient
+    vectors; sigmas: 5 coefficient vectors.
+
+    When built by a device backend the host coefficient lists are LAZY:
+    the device handles are what the prover consumes (registered via
+    backend.register_pk_polys), and materializing 18 host int lists
+    (~150 MB of tunnel traffic at the 2^18 workload) only happens if an
+    oracle/fleet consumer actually asks for them."""
+
+    def __init__(self, ck, selectors, sigmas, vk, domain, lazy=None):
+        self.ck = ck
+        self._selectors = selectors
+        self._sigmas = sigmas
+        self._lazy = lazy  # () -> (selector_lists, sigma_lists)
         self.vk = vk
         self.domain = domain
+
+    def _materialize(self):
+        if self._selectors is None:
+            self._selectors, self._sigmas = self._lazy()
+            self._lazy = None  # release the captured backend/device handles
+
+    @property
+    def selectors(self):
+        self._materialize()
+        return self._selectors
+
+    @property
+    def sigmas(self):
+        self._materialize()
+        return self._sigmas
 
     @property
     def domain_size(self):
@@ -146,17 +171,35 @@ def preprocess(srs, circuit, backend=None):
         while len(ck) % 32 != 0:
             ck.append(None)
 
+    lazy = None
     if backend is not None:
-        selectors = [backend.ifft(domain, col) for col in circuit.selectors]
-        sigmas = [backend.ifft(domain, col) for col in circuit.sigma_values()]
-        comms = backend.commit_many(ck, selectors + sigmas)
-        selector_comms = comms[:len(selectors)]
-        sigma_comms = comms[len(selectors):]
+        # the 18 iFFTs run as batched launches and the 18 commitments as
+        # batched MSMs over poly HANDLES (device-resident end to end) —
+        # round-2's per-poly int-list path made preprocess 14x the prove
+        # (266 s at 2^13, scale_2p13.json) because every selector round-
+        # tripped the host; this is the reference's join_all fan-out
+        # (src/dispatcher2.rs:294-321) applied to setup
+        cols = list(circuit.selectors) + list(circuit.sigma_values())
+        assert len(circuit.selectors) == NUM_SELECTORS
+        assert len(cols) == NUM_SELECTORS + NUM_WIRE_TYPES
+        if hasattr(backend, "lift_many"):
+            hs = backend.lift_many(cols)
+        else:
+            hs = [backend.lift(col) for col in cols]
+        chs = backend.ifft_many(domain, hs)
+        comms = backend.commit_many_h(ck, chs)
+        selector_comms = comms[:NUM_SELECTORS]
+        sigma_comms = comms[NUM_SELECTORS:]
+        sel_h, sig_h = chs[:NUM_SELECTORS], chs[NUM_SELECTORS:]
+        selectors = sigmas = None
+        lazy = lambda: ([backend.lower(h) for h in sel_h],
+                        [backend.lower(h) for h in sig_h])
     else:
         selectors = [P.ifft(domain, col) for col in circuit.selectors]
         sigmas = [P.ifft(domain, col) for col in circuit.sigma_values()]
         selector_comms = [commit_host(ck, s) for s in selectors]
         sigma_comms = [commit_host(ck, s) for s in sigmas]
+        assert len(selectors) == NUM_SELECTORS and len(sigmas) == NUM_WIRE_TYPES
 
     vk = VerifyingKey(
         domain_size=n,
@@ -168,5 +211,9 @@ def preprocess(srs, circuit, backend=None):
         g2=srs.g2,
         tau_g2=srs.tau_g2,
     )
-    assert len(selectors) == NUM_SELECTORS and len(sigmas) == NUM_WIRE_TYPES
-    return ProvingKey(ck, selectors, sigmas, vk, domain), vk
+    pk = ProvingKey(ck, selectors, sigmas, vk, domain, lazy=lazy)
+    if backend is not None and hasattr(backend, "register_pk_polys"):
+        # seed the backend's device cache so the prover's pk_polys() does
+        # not re-lift host coefficient lists it just computed on device
+        backend.register_pk_polys(pk, sel_h, sig_h)
+    return pk, vk
