@@ -1,0 +1,71 @@
+"""Per-device memory plan for mesh NTT/MSM at reference scale.
+
+The reference's v2 workload pushes the quotient domain to 2^21
+(/root/reference/src/dispatcher2.rs:246: m = 6(n+1)+1 rounded up for the
+2^18 main domain) and shards it over 2 workers whose footprint is O(N/P)
+rows + O(N/P) columns (src/worker.rs:223-227). This module computes the
+same budget for the TPU mesh layout so configurations are validated
+BEFORE a 9-figure-element allocation hits a chip (tests assert the v5e
+numbers; scripts consult it when picking chunk sizes).
+
+Layout recap (ntt_mesh.MeshNttPlan): N = r*c, rows sharded over the mesh
+axis; every element is 16 u32 limbs (64 B). Constant tables (mid twiddles,
+coset pre/post scales) are row-sharded alongside the data.
+"""
+
+FR_BYTES_DEVICE = 16 * 4  # (16,) uint32 limbs per element
+
+# peak transient multiplier for one f32-path mont_mul over a batch: the
+# dominant intermediate is the (2L, 2L, batch) f32 byte-product tensor
+# (32*32*4 B/element for Fr) when XLA materializes it un-fused — the
+# worst-case bound chunk planners must respect
+FR_MONT_MUL_TRANSIENT = 32 * 32 * 4
+
+
+def _split_rc(n):
+    log_n = n.bit_length() - 1
+    r = 1 << (log_n // 2)
+    return r, n // r
+
+
+def ntt_mesh_plan(n, n_devices, batch=1):
+    """Byte budget for a batch-B mesh NTT of size n over n_devices.
+
+    Returns a dict of per-device byte counts:
+      data: the sharded (16, B, c/d, r) working array (stage 1 view)
+      tables: mid twiddles + coset pre/post scales (row-sharded, x3)
+      transient_full: worst-case un-fused mont_mul byte-product tensor
+      transient_stage: same, if the kernel chunks the batch to one row block
+      total_fused / total_worst: planning envelopes
+    """
+    r, c = _split_rc(n)
+    local = n // n_devices
+    data = FR_BYTES_DEVICE * batch * local
+    tables = 3 * FR_BYTES_DEVICE * local  # mid + pre + post, row-sharded
+    transient_full = FR_MONT_MUL_TRANSIENT * batch * local
+    # double-buffer: input + output of each fused stage
+    total_fused = 2 * data + tables
+    total_worst = 2 * data + tables + transient_full
+    return {
+        "r": r, "c": c, "local_elems": local,
+        "data": data, "tables": tables,
+        "transient_full": transient_full,
+        "total_fused": total_fused, "total_worst": total_worst,
+    }
+
+
+def msm_mesh_plan(n, n_devices, batch=1, c_bits=8, signed=True,
+                  group=512):
+    """Byte budget for a batch-B mesh MSM of n points over n_devices."""
+    fq = 24 * 4
+    local = -(-n // n_devices)
+    windows = 256 // c_bits
+    buckets = 1 << (c_bits - 1) if signed else 1 << c_bits
+    coords = 2 if signed else 3  # affine bases vs jacobian
+    bases = coords * fq * local
+    digits = 4 * batch * windows * local
+    planes = 3 * fq * group * batch * windows * buckets
+    return {
+        "local_points": local, "bases": bases, "digits": digits,
+        "planes": planes, "total": bases + digits + 2 * planes,
+    }

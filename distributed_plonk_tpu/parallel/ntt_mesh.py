@@ -184,4 +184,10 @@ class MeshNttPlan:
         padded = list(values) + [0] * (self.n - len(values))
         v = ints_to_limbs(padded, FR_LIMBS)  # host numpy; jit places on mesh
         out = self.kernel(inverse, coset, boundary="plain")(v)
+        if jax.process_count() > 1:
+            # multi-controller: the result is sharded across hosts; gather
+            # it to a replicated layout (DCN all-gather) so every process
+            # can read the full vector
+            rep = jax.sharding.NamedSharding(self.mesh, P(None, None))
+            out = jax.jit(lambda x: x, out_shardings=rep)(out)
         return limbs_to_ints(np.asarray(out))

@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--proofs", type=int, default=1)
     ap.add_argument("--out", default=None)
     ap.add_argument("--skip-verify", action="store_true")
+    ap.add_argument("--single-prove", action="store_true",
+                    help="one prove only (cold==warm; at 2^18 scale a second"
+                         " prove doubles a long run for little signal)")
     args = ap.parse_args()
 
     from distributed_plonk_tpu import kzg
@@ -68,11 +71,12 @@ def main():
     # warm-up prove to separate XLA compile time from steady-state wall-clock
     # (the reference's Rust binaries have no compile phase; steady-state is
     # the honest comparison, cold includes jit)
-    t0 = time.perf_counter()
-    prove(random.Random(13), ckt, pk, backend)
-    res["prove_cold_s"] = round(time.perf_counter() - t0, 3)
-    print(f"[scale] prove (cold, incl. compile): {res['prove_cold_s']}s",
-          file=sys.stderr)
+    if not args.single_prove:
+        t0 = time.perf_counter()
+        prove(random.Random(13), ckt, pk, backend)
+        res["prove_cold_s"] = round(time.perf_counter() - t0, 3)
+        print(f"[scale] prove (cold, incl. compile): {res['prove_cold_s']}s",
+              file=sys.stderr)
 
     tracer = Tracer()
     t0 = time.perf_counter()
