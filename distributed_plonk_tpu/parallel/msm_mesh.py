@@ -97,11 +97,13 @@ class MeshMsmContext:
         # commit the replicated fold result to ONE device: otherwise the
         # finish jit inherits the 8-way replicated sharding and every
         # device redundantly executes the whole tail. Under multi-controller
-        # the device must be LOCAL to this process (each process runs the
-        # tail on its own replica; results are identical by construction).
+        # the global array is not fully addressable, so each process pulls
+        # its LOCAL replica (identical by construction) and runs the tail
+        # on its own first device.
         dev = next((d for d in self.mesh.devices.ravel()
                     if d.process_index == jax.process_index()),
                    self.mesh.devices.ravel()[0])
-        buckets = tuple(jax.device_put(b, dev) for b in buckets)
+        buckets = tuple(jax.device_put(b.addressable_data(0), dev)
+                        for b in buckets)
         tx, ty, tz = self._finish(*buckets)
         return msm_jax._jac_limbs_to_affine(tx, ty, tz)

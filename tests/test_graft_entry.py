@@ -19,6 +19,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 # generous vs the ~2 min measured cold; catches a regression back toward
@@ -45,6 +47,7 @@ def _run_dryrun(env):
     return proc, time.time() - t0
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8_within_budget():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # force the plain CPU platform
@@ -56,6 +59,7 @@ def test_dryrun_multichip_8_within_budget():
     assert elapsed < BUDGET_S
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8_survives_dead_relay():
     """The driver's actual failure condition: axon env present, relay dead."""
     env = dict(os.environ)
@@ -67,6 +71,7 @@ def test_dryrun_multichip_8_survives_dead_relay():
     assert elapsed < BUDGET_S
 
 
+@pytest.mark.slow
 def test_bench_emits_valid_json_with_dead_relay():
     """bench.py must print one valid JSON line at rc=0 even when the TPU is
     unreachable (round-2 failure: BENCH_r02.json was rc=1, parsed:null)."""

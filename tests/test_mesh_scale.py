@@ -27,6 +27,7 @@ def mesh8():
     return make_mesh(8, platform="cpu")
 
 
+@pytest.mark.slow
 def test_mesh_ntt_2p14(mesh8):
     from distributed_plonk_tpu.parallel.ntt_mesh import MeshNttPlan
 
@@ -43,6 +44,7 @@ def test_mesh_ntt_2p14(mesh8):
     assert elapsed < 600, f"mesh 2^14 iNTT took {elapsed:.0f}s"
 
 
+@pytest.mark.slow
 def test_mesh_msm_2p12(mesh8):
     from distributed_plonk_tpu.parallel.msm_mesh import MeshMsmContext
 

@@ -17,6 +17,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 _CHILD = r"""
@@ -60,6 +62,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 def test_two_process_distributed_mesh():
     port = _free_port()
     coord = f"127.0.0.1:{port}"
