@@ -25,11 +25,30 @@ import jax.numpy as jnp
 # Persistent compilation cache: limb-arithmetic graphs are large (O(log n)
 # fused stages, ~1k ops each) and compile time dominates cold-start
 # wall-clock. Defer to the standard JAX env knob when the user set it.
+# The cache is partitioned per machine fingerprint: XLA:CPU AOT entries
+# embed host CPU features, and loading another host's entries fails with
+# "machine feature mismatch" warnings (round-2 weakness) — separate
+# subdirectories make every host build/read only its own entries.
 if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    _default_cache = os.environ.get(
-        "DPT_JAX_CACHE_DIR",
-        os.path.normpath(os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache")),
-    )
+    import hashlib as _hashlib
+    import platform as _platform
+    _cpu = ""
+    try:  # CPU feature flags are what the AOT entries actually depend on
+        with open("/proc/cpuinfo") as _f:
+            for _line in _f:
+                if _line.startswith("flags"):
+                    _cpu = _line
+                    break
+    except OSError:
+        pass
+    _fp = _hashlib.sha256(
+        f"{_platform.machine()}|{_cpu}".encode()).hexdigest()[:12]
+    _default_cache = os.path.join(
+        os.environ.get(
+            "DPT_JAX_CACHE_DIR",
+            os.path.normpath(os.path.join(
+                os.path.dirname(__file__), "..", "..", ".jax_cache"))),
+        _fp)
     try:
         jax.config.update("jax_compilation_cache_dir", _default_cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -61,6 +61,8 @@ class FftTask:
         self.col_ranges = col_ranges       # every worker's stage-2 range (k1)
         self.cs, self.ce = col_ranges[me]
         self.rows = [None] * (re - rs)     # [local j2] -> length-r row (ints)
+        self.rows_mat = None               # (16, re-rs, r) panel (jax path)
+        self.rows_filled = np.zeros(re - rs, dtype=bool)
         # [16, local k1, j2] stage-2 input columns; fill_mask tracks exchange
         # completeness per (column, row) cell — a REGION mask, not a counter,
         # so a retried FFT2_PREPARE (same panels re-pushed after a dispatcher
@@ -89,6 +91,13 @@ class WorkerState:
         self.peers = {}
         self.peer_lock = threading.Lock()
         self.counters = {}
+        # jax workers run whole FFT1/FFT2 frames as single batched device
+        # launches over limb panels (no per-row dispatch, no host ints)
+        if getattr(backend, "name", "") == "jax":
+            from .jax_stages import StageKernels
+            self.stages = StageKernels()
+        else:
+            self.stages = None
 
     def domain(self, n):
         if n not in self.domains:
@@ -240,13 +249,24 @@ def _dispatch(conn, state, tag, payload):
             task = state.fft_tasks[task_id]
             domain_r = state.domain(task.r)
         count = panel.shape[1]
-        ints = protocol.matrix_to_ints(panel.reshape(16, count * panel.shape[2]))
-        row_len = panel.shape[2]
-        for off in range(count):
-            j2 = first_row + off
-            task.rows[j2 - task.rs] = _stage1_row(
-                state.backend, domain_r, task, j2,
-                ints[off * row_len:(off + 1) * row_len])
+        if state.stages is not None:
+            staged = state.stages.stage1_panel(task, first_row, panel)
+            lo = first_row - task.rs
+            with task.cols_lock:
+                if task.rows_mat is None:
+                    task.rows_mat = np.zeros(
+                        (16, task.re - task.rs, task.r), dtype=np.uint32)
+                task.rows_mat[:, lo:lo + count, :] = staged
+                task.rows_filled[lo:lo + count] = True
+        else:
+            ints = protocol.matrix_to_ints(
+                panel.reshape(16, count * panel.shape[2]))
+            row_len = panel.shape[2]
+            for off in range(count):
+                j2 = first_row + off
+                task.rows[j2 - task.rs] = _stage1_row(
+                    state.backend, domain_r, task, j2,
+                    ints[off * row_len:(off + 1) * row_len])
         conn.send(protocol.OK)
     elif tag == protocol.FFT2_PREPARE:
         (task_id,) = struct.unpack_from("<Q", payload, 0)
@@ -257,10 +277,19 @@ def _dispatch(conn, state, tag, payload):
         # to the dispatcher implies all our data has landed. Rows go out as
         # ONE contiguous limb panel per peer (bulk codec, no per-row lists).
         if task.re > task.rs:
-            flat = [v for j2 in range(task.rs, task.re)
-                    for v in task.rows[j2 - task.rs]]
-            rows_np = protocol.ints_to_matrix(flat).reshape(
-                16, task.re - task.rs, task.r)
+            if task.rows_mat is not None:
+                # loud failure if any row range never saw an FFT1 frame —
+                # the zero-initialized panel must not ship silently (the
+                # int path raised on a None row here)
+                assert task.rows_filled.all(), \
+                    f"fft2_prepare before stage 1 complete " \
+                    f"({task.rows_filled.sum()}/{task.rows_filled.size})"
+                rows_np = task.rows_mat
+            else:
+                flat = [v for j2 in range(task.rs, task.re)
+                        for v in task.rows[j2 - task.rs]]
+                rows_np = protocol.ints_to_matrix(flat).reshape(
+                    16, task.re - task.rs, task.r)
             for p, (ps, pe) in enumerate(task.col_ranges):
                 if pe == ps:
                     continue
@@ -296,13 +325,19 @@ def _dispatch(conn, state, tag, payload):
             assert task.fill_mask.all(), \
                 f"fft2 before exchange complete ({task.fill_mask.sum()}" \
                 f"/{task.fill_mask.size})"
-            out = []
-            for local, k1 in enumerate(range(task.cs, task.ce)):
-                row = protocol.matrix_to_ints(task.cols[:, local, :])
-                out.extend(_stage2_row(state.backend, domain_c, task, k1, row))
-            # reply rides the bulk codec (wire-identical to encode_scalars)
-            task.result = protocol.encode_scalar_matrix(
-                protocol.ints_to_matrix(out))
+            if state.stages is not None and task.ce > task.cs:
+                staged = state.stages.stage2_panel(task, task.cols)
+                task.result = protocol.encode_scalar_matrix(
+                    staged.reshape(16, staged.shape[1] * staged.shape[2]))
+            else:
+                out = []
+                for local, k1 in enumerate(range(task.cs, task.ce)):
+                    row = protocol.matrix_to_ints(task.cols[:, local, :])
+                    out.extend(
+                        _stage2_row(state.backend, domain_c, task, k1, row))
+                # reply rides the bulk codec (wire-identical path)
+                task.result = protocol.encode_scalar_matrix(
+                    protocol.ints_to_matrix(out))
             task.done_at = time.monotonic()
         conn.send(protocol.OK, task.result)
     elif tag == protocol.STATS:

@@ -51,35 +51,45 @@ def test_native_transpose():
 
 # --- transport + fleet -------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def fleet(tmp_path_factory):
-    cfg_path = str(tmp_path_factory.mktemp("rt") / "network.json")
-    base = 19000 + (os.getpid() % 500) * 2
+def _spawn_fleet(tmp_path_factory, backend, port_base, startup_s):
+    """Start a 2-worker fleet; yields a connected Dispatcher and always
+    reaps the worker processes (including when startup fails)."""
+    cfg_path = str(tmp_path_factory.mktemp(f"rt-{backend}") / "network.json")
+    base = port_base + (os.getpid() % 500) * 2
     cfg = NetworkConfig([f"127.0.0.1:{base}", f"127.0.0.1:{base + 1}"])
     cfg.save(cfg_path)
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
-             str(i), cfg_path, "--backend", "python"],
+             str(i), cfg_path, "--backend", backend],
             cwd=REPO)
         for i in range(2)
     ]
-    # wait for both listeners
-    d = None
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        try:
-            d = Dispatcher(cfg)
-            d.ping()
-            break
-        except (ConnectionError, OSError):
-            time.sleep(0.3)
-            d = None
-    assert d is not None, "workers did not come up"
-    yield d
-    d.shutdown()
-    for p in procs:
-        p.wait(timeout=10)
+    try:
+        d = None
+        deadline = time.time() + startup_s
+        while time.time() < deadline:
+            try:
+                d = Dispatcher(cfg)
+                d.ping()
+                break
+            except (ConnectionError, OSError):
+                time.sleep(0.3)
+                d = None
+        assert d is not None, f"{backend} workers did not come up"
+        yield d
+        d.shutdown()
+        for p in procs:
+            p.wait(timeout=10)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    yield from _spawn_fleet(tmp_path_factory, "python", 19000, 30)
 
 
 def test_distributed_msm(fleet):
@@ -183,3 +193,30 @@ def test_sharded_fft_2p16_within_budget(fleet):
     # generous for a 1-core CI host driving 2 python-backend workers; the
     # round-2 per-int plane was far beyond this at 2^16
     assert elapsed < 420, f"fleet 2^16 iFFT took {elapsed:.0f}s"
+
+
+@pytest.fixture(scope="module")
+def jax_fleet(tmp_path_factory):
+    """Two workers on the JAX backend: FFT1/FFT2 run as single batched
+    device launches over limb panels (runtime/jax_stages.py)."""
+    yield from _spawn_fleet(tmp_path_factory, "jax", 21000, 60)
+
+
+@pytest.mark.parametrize("coset", [False, True])
+@pytest.mark.parametrize("inverse", [False, True])
+def test_jax_fleet_sharded_fft(jax_fleet, inverse, coset):
+    """Cross-worker 4-step FFT on jax workers (batched stage kernels) ==
+    oracle, all mode combos, square and uneven splits."""
+    for n in (64, 128):
+        domain = P.Domain(n)
+        values = [RNG.randrange(R_MOD) for _ in range(n)]
+        if inverse and coset:
+            want = P.coset_ifft(domain, values)
+        elif inverse:
+            want = P.ifft(domain, values)
+        elif coset:
+            want = P.coset_fft(domain, values)
+        else:
+            want = P.fft(domain, values)
+        got = jax_fleet.fft_dist(values, inverse=inverse, coset=coset)
+        assert got == want, (n, inverse, coset)
