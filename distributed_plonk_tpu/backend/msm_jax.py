@@ -136,16 +136,19 @@ def _bucket_scan(px, py, pz, digits, group, n_buckets):
 
 
 def _bucket_scan_signed(ax, ay, ainf, packed, group):
-    """One window's SIGNED-digit bucket accumulation with mixed adds —
-    the c=8 hot path: half the buckets of the unsigned scan (128 columns,
-    bucket i holds points whose |digit| == i+1; the sign is applied to the
-    point's y on the fly) and madd-2007-bl instead of the full Jacobian add
-    (the base is affine by construction — ark-ec's Pippenger leans on the
-    same two tricks, reference src/worker.rs:122).
+    """One window's SIGNED-digit bucket accumulation with COMPLETE
+    projective mixed adds — the c=8 hot path: half the buckets of the
+    unsigned scan (128 columns, bucket i holds points whose |digit| ==
+    i+1; the sign is applied to the point's y on the fly), and the
+    accumulator add is RCB15's complete formula (11 muls in 2 stacked-lane
+    instances, NO doubling fallback and NO edge selects — branch-free by
+    construction, the vector-machine-native choice; ark-ec's Pippenger
+    gets the same effect from CPU-side branches, reference
+    src/worker.rs:122).
 
     ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (n,) uint32
     = digit + 128 with digit in [-128, 127]. Returns ((24, group, 128),)*3
-    Jacobian bucket planes.
+    PROJECTIVE bucket planes.
     """
     n = ax.shape[1]
     steps = n // group
@@ -167,14 +170,14 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group):
           to_scan1(idx))
 
     vz = ax.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    bx, by, bz = (b + vz for b in CJ.pt_inf((group, 128)))
+    bx, by, bz = (b + vz for b in CJ.proj_inf((group, 128)))
 
     def step(carry, x):
         bx, by, bz = carry
         sx, sy, sk, ng, dg = x
         cur = (bx[:, garange, dg], by[:, garange, dg], bz[:, garange, dg])
         qy = FJ.select(ng, FJ.neg(CJ.FQ, sy), sy)
-        nx, ny, nz = CJ.jac_add_mixed(cur, (sx, qy), sk)
+        nx, ny, nz = CJ.proj_add_mixed(cur, (sx, qy), sk)
         return (bx.at[:, garange, dg].set(nx),
                 by.at[:, garange, dg].set(ny),
                 bz.at[:, garange, dg].set(nz)), None
@@ -183,22 +186,25 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group):
     return bx, by, bz
 
 
-def fold_planes(bx, by, bz):
+def fold_planes(bx, by, bz, signed=False):
     """(K, 24, W, B) bucket planes -> (24, W, B) bucketwise sum.
 
     Used for both the group fold and the mesh cross-device fold: the scan
     body is identical in both calls, so XLA compiles it once per program.
+    signed planes are projective (complete adds); unsigned are Jacobian.
     (A log-depth pairwise tree was tried here and reverted: its first
-    level is a jac_add over K/2 planes at once, whose mont_mul column
+    level is an add over K/2 planes at once, whose mont_mul column
     tensors transiently need ~150x the plane bytes — 33 GB at a batched
     2^10 MSM. The scan touches one plane per step, keeping transients at
     1/K of that; with batched pipelines the per-step lanes are wide enough
     that the sequential depth is not the bottleneck.)"""
+    add = CJ.proj_add if signed else CJ.jac_add
+    inf = CJ.proj_inf if signed else CJ.pt_inf
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    init = tuple(b + vz for b in CJ.pt_inf(bz.shape[2:]))
+    init = tuple(b + vz for b in inf(bz.shape[2:]))
 
     def red(acc, plane):
-        return CJ.jac_add(acc, plane), None
+        return add(acc, plane), None
 
     acc, _ = lax.scan(red, init, (bx, by, bz))
     return acc
@@ -222,15 +228,19 @@ def finish(bx, by, bz, signed=False):
          (acc_w ends as 2^(c*w) * A_w), `shift=h` steps add acc[w+h] into
          acc[w] for w < h (pairwise tree); the total lands in lane 0.
 
-    signed=True: planes come from _bucket_scan_signed — B = 2^(c-1)
-    columns where column i weighs (i+1), so phase 1 scans ALL columns
-    (reversed) instead of dropping column 0.
+    signed=True: planes come from _bucket_scan_signed — PROJECTIVE points
+    (complete adds throughout, so the shift=0 "doubling" steps and every
+    identity lane need no special handling at all), B = 2^(c-1) columns
+    where column i weighs (i+1), so phase 1 scans ALL columns (reversed)
+    instead of dropping column 0.
     """
     wins, buckets = bz.shape[1], bz.shape[2]
     c = SCALAR_BITS // wins
     assert buckets == (1 << (c - 1) if signed else 1 << c), (wins, buckets)
+    add = CJ.proj_add if signed else CJ.jac_add
+    inf = CJ.proj_inf if signed else CJ.pt_inf
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    inf_w = tuple(x + vz for x in CJ.pt_inf((wins,)))
+    inf_w = tuple(x + vz for x in inf((wins,)))
 
     # phase 1: bucket columns (weight order), then one infinity flush column
     def col_xs(a):  # (24, W, B) -> (B, 24, W): high-weight column first
@@ -245,7 +255,7 @@ def finish(bx, by, bz, signed=False):
         left = tuple(v for v in carry)
         right = tuple(jnp.stack([xi, v[:, :, 0]], axis=2)
                       for xi, v in zip(x, left))
-        out = CJ.jac_add(left, right)
+        out = add(left, right)
         return out, None
 
     init = tuple(jnp.stack([i, i], axis=2) for i in inf_w)
@@ -266,7 +276,7 @@ def finish(bx, by, bz, signed=False):
     def weight(carry, step):
         shift, mask = step
         rolled = tuple(jnp.roll(v, -shift, axis=1) for v in carry)
-        summed = CJ.jac_add(carry, rolled)
+        summed = add(carry, rolled)
         return tuple(jnp.where(mask[None, :], s, v)
                      for s, v in zip(summed, carry)), None
 
@@ -299,7 +309,7 @@ def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
     wb = jax.vmap(partial(_bucket_scan_signed, group=group),
                   in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
     planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)
-    return fold_planes(*planes)
+    return fold_planes(*planes, signed=True)
 
 
 def finish_batch(acc_x, acc_y, acc_z, batch, signed=False):
@@ -463,8 +473,9 @@ class MsmContext:
                         padded_n=self.padded_n))
         self._chunk_fns = {}
         self._finish_fns = {}
+        merge_add = CJ.proj_add if self.signed else CJ.jac_add
         self._merge_fn = jax.jit(
-            lambda a, b: CJ.jac_add(tuple(a), tuple(b)))
+            lambda a, b: merge_add(tuple(a), tuple(b)))
 
     # one device execution is kept under ~10^7 lane-adds (~25 s at the
     # measured 2.5 us/lane-add): the tunneled runtime kills executions in
@@ -523,13 +534,15 @@ class MsmContext:
     def _run_batches(self, items, make_digits):
         """items -> affine points; digits are materialized per batch chunk
         so peak digit memory is _BATCH_CHUNK tensors, not len(items)."""
+        to_affine = _proj_limbs_to_affine if self.signed \
+            else _jac_limbs_to_affine
         out = []
         for i in range(0, len(items), self._BATCH_CHUNK):
             digits = jnp.stack(
                 [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
             tx, ty, tz = self._exec_chunked(digits)
             tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
-            out.extend(_jac_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
+            out.extend(to_affine(tx[:, j], ty[:, j], tz[:, j])
                        for j in range(digits.shape[0]))
         return out
 
@@ -557,6 +570,19 @@ def _jac_limbs_to_affine(tx, ty, tz):
         return limbs_to_int(np.asarray(v)) * CJ._MONT_R_INV % Q_MOD
 
     return C.g1_from_jac((dec(tx), dec(ty), dec(tz)))
+
+
+def _proj_limbs_to_affine(tx, ty, tz):
+    """Homogeneous projective (X : Y : Z) Montgomery limbs -> affine host
+    ints or None (signed-pipeline results)."""
+    def dec(v):
+        return limbs_to_int(np.asarray(v)) * CJ._MONT_R_INV % Q_MOD
+
+    z = dec(tz)
+    if z == 0:
+        return None
+    zi = pow(z, Q_MOD - 2, Q_MOD)
+    return (dec(tx) * zi % Q_MOD, dec(ty) * zi % Q_MOD)
 
 
 def msm(bases_affine, scalars):

@@ -247,7 +247,6 @@ def _dispatch(conn, state, tag, payload):
         task_id, first_row, panel = protocol.decode_fft1_matrix(payload)
         with state.lock:
             task = state.fft_tasks[task_id]
-            domain_r = state.domain(task.r)
         count = panel.shape[1]
         if state.stages is not None:
             staged = state.stages.stage1_panel(task, first_row, panel)
@@ -259,6 +258,8 @@ def _dispatch(conn, state, tag, payload):
                 task.rows_mat[:, lo:lo + count, :] = staged
                 task.rows_filled[lo:lo + count] = True
         else:
+            with state.lock:
+                domain_r = state.domain(task.r)
             ints = protocol.matrix_to_ints(
                 panel.reshape(16, count * panel.shape[2]))
             row_len = panel.shape[2]

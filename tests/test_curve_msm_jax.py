@@ -60,23 +60,52 @@ def test_msm_short_scalars_and_reuse():
     assert ctx.msm(s2) == C.g1_msm(bases, s2)
 
 
-def test_jac_add_mixed_matches_oracle():
-    """madd-2007-bl (the signed bucket scan's add) vs the oracle, including
-    every edge case: P==Q (doubling fallback), P==-Q (infinity), P at
-    infinity, Q flagged infinite, and the generic sum."""
-    import jax.numpy as jnp
+def _proj_to_affine_list(p3):
+    """Per-column decode via the production converter (no re-implementation
+    of the Montgomery/Z-inversion logic)."""
     import numpy as np
+
+    tx, ty, tz = (np.asarray(c) for c in p3)
+    return [msm_jax._proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
+            for j in range(tx.shape[1])]
+
+
+def _affine_to_proj(points):
+    """list[(x, y) | None] -> projective device tuple ((24, n),)*3 with
+    identity = (0 : 1 : 0)."""
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.constants import Q_MOD, FQ_MONT_R
+    from distributed_plonk_tpu.backend.limbs import ints_to_limbs
+
+    xs = [(p[0] * FQ_MONT_R % Q_MOD) if p else 0 for p in points]
+    ys = [(p[1] * FQ_MONT_R % Q_MOD) if p else FQ_MONT_R % Q_MOD
+          for p in points]
+    zs = [FQ_MONT_R % Q_MOD if p else 0 for p in points]
+    return tuple(jnp.asarray(ints_to_limbs(v, 24)) for v in (xs, ys, zs))
+
+
+def test_proj_complete_add_matches_oracle():
+    """RCB15 complete adds (the signed bucket pipeline's group ops) vs the
+    oracle, covering the cases a complete formula must absorb with no
+    special handling: P+Q, P+P, P+(-P), identity on either/both sides."""
+    import jax.numpy as jnp
 
     p = _rand_points(1)[0]
     q = _rand_points(1)[0]
     lhs = [p, p, p, None, None, p, q]
     rhs = [p, C.g1_neg(p), None, p, None, q, p]
-    dev_l = CJ.affine_to_device(lhs)
+    want = [C.g1_add_affine(a, b) for a, b in zip(lhs, rhs)]
+
+    got = _proj_to_affine_list(jax.jit(CJ.proj_add)(
+        _affine_to_proj(lhs), _affine_to_proj(rhs)))
+    assert got == want
+
+    # mixed variant: q affine + inf mask (q = None lanes masked)
     x, y, inf = msm_jax.points_to_device(rhs, 0)
-    q_inf = jnp.asarray(inf)
-    got = CJ.device_to_affine(jax.jit(CJ.jac_add_mixed)(
-        dev_l, (jnp.asarray(x), jnp.asarray(y)), q_inf))
-    assert got == [C.g1_add_affine(a, b) for a, b in zip(lhs, rhs)]
+    got_m = _proj_to_affine_list(jax.jit(CJ.proj_add_mixed)(
+        _affine_to_proj(lhs), (jnp.asarray(x), jnp.asarray(y)),
+        jnp.asarray(inf)))
+    assert got_m == want
 
 
 def test_batch_to_affine_roundtrip():
