@@ -158,10 +158,6 @@ def proj_inf(batch_shape=()):
     return (zero, one, zero)
 
 
-def proj_is_inf(p):
-    return FJ.is_zero(FQ, p[2])
-
-
 def proj_add(p, q):
     """Complete projective P + Q (RCB15 algorithm 7, a=0): 12 full muls in
     2 stacked-lane instances + 2 cheap b3 multiplies. No special cases."""

@@ -6,29 +6,31 @@ The reference gets its SRS from jf-plonk's `universal_setup`
 reference-size domains (2^18 powers = 2^18 sequential scalar muls), so here
 it becomes one device program: a windowed fixed-base table is precomputed
 once on the host (the base is a single public generator — 32 windows x 256
-multiples, ~8k cheap host adds), and the batch [s_i]G for all N scalars is
-a lax.scan over the 32 windows whose body gathers each scalar's digit row
-from the table and performs ONE vectorized Jacobian add across the whole
-batch. Like the MSM pipeline (msm_jax.py), the traced program contains a
-single jac_add instance, so compile time is O(1) in N.
+multiples, ~8k cheap host adds, normalized to AFFINE with one batched
+inversion), and the batch [s_i]G for all N scalars is a lax.scan over the
+32 windows whose body gathers each scalar's digit row from the table and
+performs ONE vectorized COMPLETE projective mixed add (RCB15; no edge
+handling, 11 muls in 2 stacked-lane instances) across the whole batch.
+Like the MSM pipeline (msm_jax.py), the traced program contains a single
+add instance, so compile time is O(1) in N.
 
-The result stays on device as Jacobian Montgomery limb arrays and feeds the
-MSM directly (MsmContext.from_jacobian) — the commit key never needs to be
-normalized to affine on the host for the prover path.
+The result converts to Jacobian in-kernel (3 muls per point: (XZ, YZ^2,
+Z)) and stays on device as Montgomery limb arrays feeding DeviceCommitKey
+— the commit key never needs host affine normalization for the prover
+path.
 """
-
-from functools import partial
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..constants import FQ_MONT_R, Q_MOD, FQ_LIMBS
+from ..constants import Q_MOD, FQ_LIMBS
 from .. import curve as C
 from . import curve_jax as CJ
-from .limbs import ints_to_limbs
-from .msm_jax import SCALAR_BITS, digits_of_scalars
+from . import field_jax as FJ
+from .field_jax import FQ
+from .msm_jax import SCALAR_BITS, digits_of_scalars, points_to_device
 
 WINDOW_BITS = 8
 N_WINDOWS = SCALAR_BITS // WINDOW_BITS  # 32
@@ -36,8 +38,9 @@ N_BUCKETS = 1 << WINDOW_BITS  # 256
 
 
 def _host_window_table(base_affine):
-    """(N_WINDOWS, N_BUCKETS) table of d * 2^(8w) * base as host Jacobian
-    int tuples; table[w][0] is the point at infinity."""
+    """(N_WINDOWS, N_BUCKETS) table of d * 2^(8w) * base as host AFFINE
+    tuples (None at index 0); one batched inversion normalizes the whole
+    Jacobian walk."""
     inf = (1, 1, 0)
     table = []
     b = C.g1_to_jac(base_affine)
@@ -50,32 +53,54 @@ def _host_window_table(base_affine):
         table.append(row)
         for _ in range(WINDOW_BITS):
             b = C.g1_jac_double(b)
-    return table
+    # batch-invert all Z coordinates (Montgomery's trick, host ints)
+    flat = [p for row in table for p in row]
+    zs = [p[2] if p[2] else 1 for p in flat]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % Q_MOD)
+    inv_total = pow(prefix[-1], Q_MOD - 2, Q_MOD)
+    invs = [0] * len(zs)
+    for i in range(len(zs) - 1, -1, -1):
+        invs[i] = prefix[i] * inv_total % Q_MOD
+        inv_total = inv_total * zs[i] % Q_MOD
+    out = []
+    for p, zi in zip(flat, invs):
+        if p[2] == 0:
+            out.append(None)
+        else:
+            zi2 = zi * zi % Q_MOD
+            out.append((p[0] * zi2 % Q_MOD, p[1] * zi2 * zi % Q_MOD))
+    return [out[w * N_BUCKETS:(w + 1) * N_BUCKETS] for w in range(N_WINDOWS)]
 
 
 def _table_to_device(table):
-    """Host Jacobian int table -> ((24, W, B),)*3 Montgomery limb arrays."""
+    """Host affine table -> ((24, W, B) x, (24, W, B) y, (W, B) inf),
+    encoded by the same converter the MSM bases use."""
     flat = [p for row in table for p in row]
-    coords = []
-    for k in range(3):
-        vals = [p[k] * FQ_MONT_R % Q_MOD for p in flat]
-        arr = ints_to_limbs(vals, FQ_LIMBS).reshape(FQ_LIMBS, N_WINDOWS, N_BUCKETS)
-        coords.append(jnp.asarray(arr))
-    return tuple(coords)
+    x, y, inf = points_to_device(flat, 0)
+    tx = jnp.asarray(x.reshape(FQ_LIMBS, N_WINDOWS, N_BUCKETS))
+    ty = jnp.asarray(y.reshape(FQ_LIMBS, N_WINDOWS, N_BUCKETS))
+    return tx, ty, jnp.asarray(inf.reshape(N_WINDOWS, N_BUCKETS))
 
 
-def _batch_mul_kernel(tx, ty, tz, digits):
-    """digits: (W, N) uint32 -> ((24, N),)*3 Jacobian sum over windows."""
-    init = CJ.pt_inf((digits.shape[1],))
+def _batch_mul_kernel(tx, ty, tinf, digits):
+    """digits: (W, N) uint32 -> ((24, N),)*3 Jacobian sum over windows
+    (accumulated with complete projective mixed adds, converted to
+    Jacobian at the end)."""
+    init = CJ.proj_inf((digits.shape[1],))
 
     def step(acc, x):
-        sx, sy, sz, dg = x  # (24, B) table row + (N,) digit column
-        return CJ.jac_add(acc, (sx[:, dg], sy[:, dg], sz[:, dg])), None
+        sx, sy, si, dg = x  # (24, B) affine table row + (N,) digit column
+        return CJ.proj_add_mixed(acc, (sx[:, dg], sy[:, dg]), si[dg]), None
 
-    xs = (tx.transpose(1, 0, 2), ty.transpose(1, 0, 2), tz.transpose(1, 0, 2),
-          digits)
-    acc, _ = lax.scan(step, init, xs)
-    return acc
+    xs = (tx.transpose(1, 0, 2), ty.transpose(1, 0, 2), tinf, digits)
+    (X, Y, Z), _ = lax.scan(step, init, xs)
+    # projective (X : Y : Z) == Jacobian (X*Z, Y*Z^2, Z)
+    xz = FJ.mont_mul(FQ, X, Z)
+    z2 = FJ.mont_mul(FQ, Z, Z)
+    yz2 = FJ.mont_mul(FQ, Y, z2)
+    return xz, yz2, Z
 
 
 class FixedBaseContext:
