@@ -6,26 +6,29 @@ W = 256/c radix-2^c windows — c size-dependent as in standard Pippenger
 (8 bits at bench scale, smaller for small MSMs) — and each window's 2^c - 1
 buckets are accumulated WITHOUT any sort or data-dependent scatter pattern:
 
-  - points are split into G groups, each group owning a private (G, 2^c)
+  - points are split into G groups, each group owning a private (G, B)
     bucket array;
   - a lax.scan walks n/G point-batches: gather current buckets at the
-    batch's digits (one per group), one G-wide vectorized Jacobian add,
-    scatter back — all writes in a step hit distinct rows, so the scan is
-    race-free by construction;
+    batch's digits (one per group), one G-wide vectorized COMPLETE
+    projective mixed add (RCB15, a=0 — no edge cases, 2 stacked-lane
+    multiplier instances), scatter back — all writes in a step hit
+    distinct rows, so the scan is race-free by construction;
   - group bucket-planes then fold sequentially with a scan whose body is a
-    single (24, W, 2^c)-shaped Jacobian add — the SAME body the mesh
-    version reuses to fold planes across devices, so XLA's computation
-    deduplication compiles it once;
-  - the remaining O(W * 2^c) tail (running-sum bucket aggregation,
+    single (24, W, B)-shaped complete projective add — the SAME body the
+    mesh version reuses to fold planes across devices, so XLA's
+    computation deduplication compiles it once;
+  - the remaining O(W * B) tail (running-sum bucket aggregation,
     2^(c*w) window weighting, final window sum) runs as two more
     static-shape scans with no data-dependent indexing at all (see
     `finish`).
 
-This keeps the optimal ~n adds/window of Pippenger while the whole MSM
-compiles exactly THREE large Jacobian-add bodies regardless of n — XLA
-compile time (the round-1 multichip-gate killer: >8 min for a 16-point
-mesh MSM) is O(1) in both n and the number of reduction phases — and every
-memory access is regular.
+Accumulators are homogeneous PROJECTIVE (X : Y : Z), identity (0 : 1 : 0);
+results decode as x = X/Z, y = Y/Z (_proj_limbs_to_affine). Large MSMs
+(c = 8) use SIGNED digits: B = 128 buckets instead of 256. This keeps the
+optimal ~n adds/window of Pippenger while the whole MSM compiles exactly
+THREE complete-add bodies regardless of n — XLA compile time (the round-1
+multichip-gate killer: >8 min for a 16-point mesh MSM) is O(1) in both n
+and the number of reduction phases — and every memory access is regular.
 """
 
 import os
@@ -41,7 +44,6 @@ from . import curve_jax as CJ
 from . import field_jax as FJ
 from .field_jax import FR
 from .limbs import ints_to_limbs, limbs_to_int
-from .. import curve as C
 
 SCALAR_BITS = 256
 
@@ -99,34 +101,42 @@ def _group_size_batch(n, batch, c, signed=False):
     return g
 
 
-def _bucket_scan(px, py, pz, digits, group, n_buckets):
-    """One window's private-group bucket accumulation.
+def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
+    """One window's private-group bucket accumulation (unsigned digits,
+    small-window path): COMPLETE projective mixed adds, like the signed
+    scan — the 2-multiplier-instance graph also compiles far faster than
+    the old 7-instance Jacobian add, which is what the multichip dry-run's
+    compile budget rides on.
 
-    px/py/pz: (24, n); digits: (n,) uint32 < n_buckets. Returns
-    ((24, group, n_buckets),)*3 with group-g bucket b = sum of g's points
-    whose digit == b (bucket 0 included but ignored downstream).
+    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; digits: (n,) uint32
+    < n_buckets. Returns ((24, group, n_buckets),)*3 PROJECTIVE planes
+    with group-g bucket b = sum of g's points whose digit == b (bucket 0
+    included but ignored downstream).
     """
-    n = px.shape[1]
+    n = ax.shape[1]
     steps = n // group
     garange = jnp.arange(group)
 
     def to_scan(a):  # (24, n) -> (steps, 24, group)
         return a.reshape(FQ_LIMBS, group, steps).transpose(2, 0, 1)
 
-    xs = (to_scan(px), to_scan(py), to_scan(pz),
-          digits.reshape(group, steps).T)
+    def to_scan1(a):  # (n,) -> (steps, group)
+        return a.reshape(group, steps).T
 
     # varying-zero: under shard_map the scan carry must inherit the inputs'
     # varying-manual-axes tag; adding a data-derived 0 does exactly that
     # (and constant-folds away otherwise)
-    vz = pz.ravel()[0] & 0
-    bx, by, bz = (b + vz for b in CJ.pt_inf((group, n_buckets)))
+    vz = ax.ravel()[0] & 0
+    bx, by, bz = (b + vz for b in CJ.proj_inf((group, n_buckets)))
+
+    xs = (to_scan(ax), to_scan(ay), to_scan1(ainf),
+          to_scan1(digits))
 
     def step(carry, x):
         bx, by, bz = carry
-        sx, sy, sz, dg = x
+        sx, sy, si, dg = x
         cur = (bx[:, garange, dg], by[:, garange, dg], bz[:, garange, dg])
-        nx, ny, nz = CJ.jac_add(cur, (sx, sy, sz))
+        nx, ny, nz = CJ.proj_add_mixed(cur, (sx, sy), si)
         return (bx.at[:, garange, dg].set(nx),
                 by.at[:, garange, dg].set(ny),
                 bz.at[:, garange, dg].set(nz)), None
@@ -186,25 +196,22 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group):
     return bx, by, bz
 
 
-def fold_planes(bx, by, bz, signed=False):
-    """(K, 24, W, B) bucket planes -> (24, W, B) bucketwise sum.
+def fold_planes(bx, by, bz):
+    """(K, 24, W, B) PROJECTIVE bucket planes -> (24, W, B) bucketwise sum.
 
     Used for both the group fold and the mesh cross-device fold: the scan
     body is identical in both calls, so XLA compiles it once per program.
-    signed planes are projective (complete adds); unsigned are Jacobian.
     (A log-depth pairwise tree was tried here and reverted: its first
     level is an add over K/2 planes at once, whose mont_mul column
     tensors transiently need ~150x the plane bytes — 33 GB at a batched
     2^10 MSM. The scan touches one plane per step, keeping transients at
     1/K of that; with batched pipelines the per-step lanes are wide enough
     that the sequential depth is not the bottleneck.)"""
-    add = CJ.proj_add if signed else CJ.jac_add
-    inf = CJ.proj_inf if signed else CJ.pt_inf
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    init = tuple(b + vz for b in inf(bz.shape[2:]))
+    init = tuple(b + vz for b in CJ.proj_inf(bz.shape[2:]))
 
     def red(acc, plane):
-        return add(acc, plane), None
+        return CJ.proj_add(acc, plane), None
 
     acc, _ = lax.scan(red, init, (bx, by, bz))
     return acc
@@ -221,26 +228,25 @@ def finish(bx, by, bz, signed=False):
 
       1. running-sum bucket aggregation: scan over bucket columns B-1..1
          (+ one infinity flush column), carry (run_w, acc_w) stacked on a
-         lane axis so each step is ONE (24, W, 2) Jacobian add —
-         pipelined:  acc += run ; run += bucket[:, b]  per step.
+         lane axis so each step is ONE (24, W, 2) complete projective add
+         — pipelined:  acc += run ; run += bucket[:, b]  per step.
       2+3. window weighting and final sum in ONE scan of (shift, mask)
          steps on (24, W): `shift=0` steps double the masked windows
          (acc_w ends as 2^(c*w) * A_w), `shift=h` steps add acc[w+h] into
          acc[w] for w < h (pairwise tree); the total lands in lane 0.
 
-    signed=True: planes come from _bucket_scan_signed — PROJECTIVE points
-    (complete adds throughout, so the shift=0 "doubling" steps and every
-    identity lane need no special handling at all), B = 2^(c-1) columns
-    where column i weighs (i+1), so phase 1 scans ALL columns (reversed)
-    instead of dropping column 0.
+    Points are PROJECTIVE with complete adds throughout, so the shift=0
+    "doubling" steps and every identity lane need no special handling at
+    all. signed=True: planes come from _bucket_scan_signed — B = 2^(c-1)
+    columns where column i weighs (i+1), so phase 1 scans ALL columns
+    (reversed) instead of dropping column 0.
     """
     wins, buckets = bz.shape[1], bz.shape[2]
     c = SCALAR_BITS // wins
     assert buckets == (1 << (c - 1) if signed else 1 << c), (wins, buckets)
-    add = CJ.proj_add if signed else CJ.jac_add
-    inf = CJ.proj_inf if signed else CJ.pt_inf
+    add = CJ.proj_add
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    inf_w = tuple(x + vz for x in inf((wins,)))
+    inf_w = tuple(x + vz for x in CJ.proj_inf((wins,)))
 
     # phase 1: bucket columns (weight order), then one infinity flush column
     def col_xs(a):  # (24, W, B) -> (B, 24, W): high-weight column first
@@ -284,9 +290,10 @@ def finish(bx, by, bz, signed=False):
     return tuple(v[:, 0] for v in acc)
 
 
-def bucket_planes_batch(px, py, pz, digits, group):
-    """B-polynomial bucket accumulation over SHARED bases: points (24, nc)
-    + digits (B, W, nc) -> folded planes ((24, B*W, 2^c),)*3.
+def bucket_planes_batch(ax, ay, ainf, digits, group):
+    """B-polynomial bucket accumulation over SHARED bases: affine points
+    (24, nc) + inf mask (nc,) + digits (B, W, nc) -> folded planes
+    ((24, B*W, 2^c),)*3.
 
     The prover's per-round commitment batches (5 wires, 5 quotient splits,
     2 openings — the join_all fan-outs of reference dispatcher2.rs:316-321,
@@ -296,7 +303,7 @@ def bucket_planes_batch(px, py, pz, digits, group):
     buckets = 1 << (SCALAR_BITS // W)
     flat = digits.reshape(B * W, n)
     wb = jax.vmap(partial(_bucket_scan, group=group, n_buckets=buckets),
-                  in_axes=(None, None, None, 0))(px, py, pz, flat)
+                  in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
     planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)  # (G, 24, B*W, buckets)
     return fold_planes(*planes)
 
@@ -309,7 +316,7 @@ def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
     wb = jax.vmap(partial(_bucket_scan_signed, group=group),
                   in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
     planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)
-    return fold_planes(*planes, signed=True)
+    return fold_planes(*planes)
 
 
 def finish_batch(acc_x, acc_y, acc_z, batch, signed=False):
@@ -320,10 +327,10 @@ def finish_batch(acc_x, acc_y, acc_z, batch, signed=False):
                     in_axes=(1, 1, 1), out_axes=1)(*acc_b)
 
 
-def msm_pipeline_batch(px, py, pz, digits, group):
+def msm_pipeline_batch(ax, ay, ainf, digits, group):
     """One-shot batched MSM (small inputs / tests): bucket accumulation +
     finish in a single program."""
-    acc = bucket_planes_batch(px, py, pz, digits, group)
+    acc = bucket_planes_batch(ax, ay, ainf, digits, group)
     return finish_batch(*acc, batch=digits.shape[0])
 
 
@@ -446,24 +453,19 @@ class MsmContext:
         # a 16-bucket (c=4) plane is layout-padded 8x — the difference
         # between a 1.2 GB and a 10+ GB program at a batched 2^10 commit
         self.c_batch = 8 if self.padded_n >= 256 else self.c
-        # c=8 runs the SIGNED pipeline: half the buckets (128 columns,
-        # sign folded into y) and mixed affine adds in the scan — which
-        # needs the bases in affine form (see _bucket_scan_signed)
+        # c=8 runs the SIGNED pipeline (half the buckets, sign folded into
+        # y); both pipelines take affine bases + inf mask and accumulate
+        # with complete projective adds
         self.signed = self.c_batch == 8
         if isinstance(bases, DeviceCommitKey):
             point = bases.point
             if pad:
                 point = tuple(jnp.pad(p, ((0, 0), (0, pad))) for p in point)
-            if self.signed:
-                # device-built SRS is Jacobian with arbitrary Z: normalize
-                # once with a batched inversion (one scalar host round-trip)
-                self.point = CJ.batch_to_affine(point)
-            else:
-                self.point = point
+            # device-built SRS is Jacobian with arbitrary Z: normalize
+            # once with a batched inversion (one scalar host round-trip)
+            self.point = CJ.batch_to_affine(point)
         else:
-            ax, ay, ainf = points_to_device(bases, pad)
-            self.point = (ax, ay, ainf) if self.signed \
-                else CJ.from_affine(ax, ay, ainf)
+            self.point = points_to_device(bases, pad)
         if self.signed:
             self._digits_batch_fn = jax.jit(
                 partial(signed_digits_from_mont, padded_n=self.padded_n))
@@ -473,9 +475,8 @@ class MsmContext:
                         padded_n=self.padded_n))
         self._chunk_fns = {}
         self._finish_fns = {}
-        merge_add = CJ.proj_add if self.signed else CJ.jac_add
         self._merge_fn = jax.jit(
-            lambda a, b: merge_add(tuple(a), tuple(b)))
+            lambda a, b: CJ.proj_add(tuple(a), tuple(b)))
 
     # one device execution is kept under ~10^7 lane-adds (~25 s at the
     # measured 2.5 us/lane-add): the tunneled runtime kills executions in
@@ -503,14 +504,13 @@ class MsmContext:
         accumulation, cheap cross-chunk plane merges, one finish tail."""
         B, W, n = digits.shape
         chunk = max(1024, (self._CALL_ADDS // (B * W)) & ~1023)
-        pa, pb, pc = self.point  # (x, y, inf) signed / (x, y, z) unsigned
+        ax, ay, ainf = self.point
         acc = None
         for i0 in range(0, n, chunk):
             nc = min(chunk, n - i0)
             g = _group_size_batch(nc, B, SCALAR_BITS // W, signed=self.signed)
             part = self._chunk_fn(nc, g)(
-                pa[:, i0:i0 + nc], pb[:, i0:i0 + nc],
-                pc[i0:i0 + nc] if self.signed else pc[:, i0:i0 + nc],
+                ax[:, i0:i0 + nc], ay[:, i0:i0 + nc], ainf[i0:i0 + nc],
                 digits[:, :, i0:i0 + nc])
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
         return self._finish_fn(B)(*acc)
@@ -534,15 +534,13 @@ class MsmContext:
     def _run_batches(self, items, make_digits):
         """items -> affine points; digits are materialized per batch chunk
         so peak digit memory is _BATCH_CHUNK tensors, not len(items)."""
-        to_affine = _proj_limbs_to_affine if self.signed \
-            else _jac_limbs_to_affine
         out = []
         for i in range(0, len(items), self._BATCH_CHUNK):
             digits = jnp.stack(
                 [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
             tx, ty, tz = self._exec_chunked(digits)
             tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
-            out.extend(to_affine(tx[:, j], ty[:, j], tz[:, j])
+            out.extend(_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
                        for j in range(digits.shape[0]))
         return out
 
@@ -564,17 +562,10 @@ class MsmContext:
         return self._run_batches(scalar_lists, make)
 
 
-def _jac_limbs_to_affine(tx, ty, tz):
-    def dec(v):
-        # from Montgomery: value * R^-1 mod q, done on host (single element)
-        return limbs_to_int(np.asarray(v)) * CJ._MONT_R_INV % Q_MOD
-
-    return C.g1_from_jac((dec(tx), dec(ty), dec(tz)))
-
-
 def _proj_limbs_to_affine(tx, ty, tz):
     """Homogeneous projective (X : Y : Z) Montgomery limbs -> affine host
-    ints or None (signed-pipeline results)."""
+    ints or None. Every pipeline result (signed, unsigned, mesh) is
+    projective; decode is x = X/Z, y = Y/Z."""
     def dec(v):
         return limbs_to_int(np.asarray(v)) * CJ._MONT_R_INV % Q_MOD
 

@@ -11,14 +11,14 @@ host-side sum-reduce of partial totals (dispatcher2.rs:888-890). (G1
 addition is not a ring sum, so `psum` does not apply; the all_gather+fold
 is the collective equivalent.) A single finish machine then turns the
 globally folded buckets into the result, so the whole mesh program
-compiles the same THREE Jacobian-add bodies as the single-device path —
-the structure that keeps the multi-chip dry-run inside the compile budget
-on a virtual CPU mesh.
+compiles the same THREE complete-projective-add bodies (RCB15; 2
+stacked-lane multiplier instances each) as the single-device path — the
+structure that keeps the multi-chip dry-run inside the compile budget on
+a virtual CPU mesh.
 """
 
 from functools import partial
 
-import numpy as np
 import jax
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -29,7 +29,6 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..backend import msm_jax
-from ..backend import curve_jax as CJ
 from .mesh import SHARD_AXIS
 
 
@@ -52,24 +51,27 @@ class MeshMsmContext:
         # device's bucket pipeline actually sees)
         self.c = msm_jax.window_bits(self.local_n)
 
-        # the mesh scan keeps the unsigned Jacobian pipeline (tiny dry-run
-        # shapes use c < 8 where the signed recode has no overflow margin);
-        # Z is built on HOST so the only device traffic is the sharded put
+        # the mesh scan keeps unsigned digits (tiny dry-run shapes use
+        # c < 8 where the signed recode has no overflow margin) but rides
+        # the same complete-projective bucket pipeline as the single-chip
+        # path; bases stay HOST numpy so the only device traffic is the
+        # sharded put
         ax, ay, ainf = msm_jax.points_to_device(bases_affine, pad)
-        z = np.where(ainf[None, :], 0,
-                     np.asarray(CJ._MONT_ONE)[:, None]).astype(np.uint32)
         shard_nd = jax.sharding.NamedSharding(mesh, P(None, SHARD_AXIS))
-        self.point = tuple(jax.device_put(c, shard_nd) for c in (ax, ay, z))
+        inf_nd = jax.sharding.NamedSharding(mesh, P(SHARD_AXIS))
+        self.point = (jax.device_put(ax, shard_nd),
+                      jax.device_put(ay, shard_nd),
+                      jax.device_put(ainf, inf_nd))
 
         shard = P(None, SHARD_AXIS)
 
-        def body(px, py, pz, digits):
+        def body(ax, ay, ainf, digits):
             # local slice: (24, local_n); digits (W, local_n)
             wb = jax.vmap(partial(msm_jax._bucket_scan, group=self.group,
                                   n_buckets=1 << self.c),
-                          in_axes=(None, None, None, 0))(px, py, pz, digits)
+                          in_axes=(None, None, None, 0))(ax, ay, ainf, digits)
             planes = tuple(b.transpose(2, 1, 0, 3) for b in wb)
-            local = msm_jax.fold_planes(*planes)  # (24, 32, 256) per device
+            local = msm_jax.fold_planes(*planes)  # (24, W, B) per device
             # fold bucket planes across the mesh on device (the reference
             # folds partial totals on the dispatcher host instead); the
             # fold body is identical to the group fold's -> compiled once
@@ -80,7 +82,7 @@ class MeshMsmContext:
         # in value, which the varying-axes checker cannot infer statically
         self._fn = jax.jit(_shard_map(
             body, mesh=mesh,
-            in_specs=(shard, shard, shard, shard),
+            in_specs=(shard, shard, P(SHARD_AXIS), shard),
             out_specs=(P(None, None, None),) * 3, check_vma=False))
         # the O(windows*buckets) finish tail runs on the replicated fold
         # result OUTSIDE the mesh program: one single-device compile (shared
@@ -92,8 +94,8 @@ class MeshMsmContext:
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
         assert len(scalars) <= self.n
         digits = msm_jax.digits_of_scalars(scalars, self.padded_n, self.c)
-        px, py, pz = self.point
-        buckets = self._fn(px, py, pz, digits)
+        ax, ay, ainf = self.point
+        buckets = self._fn(ax, ay, ainf, digits)
         # commit the replicated fold result to ONE device: otherwise the
         # finish jit inherits the 8-way replicated sharding and every
         # device redundantly executes the whole tail. Under multi-controller
@@ -106,4 +108,4 @@ class MeshMsmContext:
         buckets = tuple(jax.device_put(b.addressable_data(0), dev)
                         for b in buckets)
         tx, ty, tz = self._finish(*buckets)
-        return msm_jax._jac_limbs_to_affine(tx, ty, tz)
+        return msm_jax._proj_limbs_to_affine(tx, ty, tz)
