@@ -30,6 +30,19 @@ def _split_rc(n):
     return r, n // r
 
 
+class _Failure:
+    def __init__(self, err):
+        self.err = err
+
+
+def _try(fn, arg):
+    """Capture a worker failure as a value so a pool.map survives it."""
+    try:
+        return fn(arg)
+    except Exception as e:
+        return _Failure(e)
+
+
 class WorkerHandle:
     """One framed connection to a worker, with a per-call timeout and one
     reconnect-retry — the failure handling the reference never had (every
@@ -81,6 +94,7 @@ class Dispatcher:
         self.workers = [WorkerHandle(h, p) for h, p in config.workers]
         self.pool = futures.ThreadPoolExecutor(max_workers=len(self.workers))
         self._ranges = None
+        self._adopted = {}  # base-range i -> worker j that adopted it
 
     def ping(self):
         for w in self.workers:
@@ -88,36 +102,93 @@ class Dispatcher:
 
     def init_bases(self, bases):
         """Range-shard the SRS: worker i holds bases[start_i:end_i]
-        (contiguous split, like MsmWorkload ranges)."""
+        (contiguous split, like MsmWorkload ranges) under set id i. The
+        full base list is retained host-side so a dead worker's range can
+        be re-provisioned onto a healthy worker mid-prove."""
         n = len(bases)
         k = len(self.workers)
         bounds = [n * i // k for i in range(k + 1)]
         self._ranges = list(zip(bounds[:-1], bounds[1:]))
-        list(self.pool.map(
-            lambda iw: iw[1].call(protocol.INIT_BASES,
-                                  protocol.encode_points(
-                                      bases[self._ranges[iw[0]][0]:
-                                            self._ranges[iw[0]][1]])),
-            enumerate(self.workers)))
+        self._bases = bases
+        self._adopted = {}
+        # a worker that is dead at provisioning time is tolerated: its
+        # range stays unowned and the first msm() adopts it onto a healthy
+        # worker through the same lazy-recovery path as a mid-prove death
+        results = self.pool.map(
+            lambda iw: _try(
+                lambda iw: iw[1].call(protocol.INIT_BASES,
+                                      protocol.encode_init_bases(
+                                          iw[0],
+                                          bases[self._ranges[iw[0]][0]:
+                                                self._ranges[iw[0]][1]])),
+                iw),
+            enumerate(self.workers))
+        if all(isinstance(r, _Failure) for r in results):
+            raise RuntimeError("no worker accepted its base range")
 
     def msm(self, scalars):
-        """Distributed MSM: scatter scalar ranges, fold partial G1 sums on
-        the host (reference dispatcher2.rs:888-890)."""
+        """Distributed MSM with elastic recovery: scatter scalar ranges,
+        fold partial G1 sums on the host (reference dispatcher2.rs:888-890
+        — where every worker failure is an unwrap panic, src/worker.rs:303;
+        here a dead worker's range is re-provisioned onto a healthy worker
+        and recomputed)."""
         assert self._ranges is not None, "init_bases first"
 
-        def part(iw):
-            i, w = iw
+        def part(i):
             start, end = self._ranges[i]
             chunk = scalars[start:end]
             if not chunk:
                 return None
-            raw = w.call(protocol.MSM, protocol.encode_scalars(chunk))
+            # an adopted range routes straight to its new owner — no
+            # re-dialing the dead worker, no re-upload
+            w = self.workers[self._adopted.get(i, i)]
+            raw = w.call(protocol.MSM,
+                         protocol.encode_msm_request(i, chunk))
             return protocol.decode_point(raw)
 
         total = None
-        for p in self.pool.map(part, enumerate(self.workers)):
-            total = C.g1_add_affine(total, p)
+        failed = []
+        for i, res in enumerate(self.pool.map(
+                lambda i: _try(part, i), range(len(self.workers)))):
+            if isinstance(res, _Failure):
+                failed.append(i)
+            else:
+                total = C.g1_add_affine(total, res)
+        if failed:
+            # recoveries run concurrently; _recover_msm spreads adoptions
+            # across the fleet starting at dead_i + 1
+            for p in self.pool.map(
+                    lambda i: self._recover_msm(i, scalars), failed):
+                total = C.g1_add_affine(total, p)
         return total
+
+    def _recover_msm(self, dead_i, scalars):
+        """Re-provision range dead_i's bases onto a healthy worker (set id
+        unchanged — ids are ranges, not workers), recompute its part, and
+        REMEMBER the adoption so later msm() calls route directly."""
+        start, end = self._ranges[dead_i]
+        chunk = scalars[start:end]
+        if not chunk:
+            return None
+        k = len(self.workers)
+        failed_owner = self._adopted.get(dead_i, dead_i)
+        last_err = None
+        for off in range(1, k + 1):
+            j = (dead_i + off) % k
+            if j == failed_owner:
+                continue
+            w = self.workers[j]
+            try:
+                w.call(protocol.INIT_BASES, protocol.encode_init_bases(
+                    dead_i, self._bases[start:end]))
+                raw = w.call(protocol.MSM,
+                             protocol.encode_msm_request(dead_i, chunk))
+                self._adopted[dead_i] = j
+                return protocol.decode_point(raw)
+            except Exception as e:  # try the next healthy worker
+                last_err = e
+        raise RuntimeError(
+            f"no healthy worker could adopt MSM range {dead_i}") from last_err
 
     def ntt(self, values, inverse=False, coset=False, worker=0):
         """Offload one whole NTT to a worker (per-polynomial task

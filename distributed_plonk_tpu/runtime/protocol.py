@@ -19,8 +19,11 @@ from . import native
 
 # tags
 PING = 1
-INIT_BASES = 2     # u64 n, then n * 97B points       -> reply OK
-MSM = 3            # u64 count, count * 32B scalars    -> reply 97B point
+INIT_BASES = 2     # u64 set_id, u64 n, then n * 97B points -> reply OK
+                   # (workers hold MULTIPLE base sets keyed by id, so a
+                   # healthy worker can adopt a dead worker's range)
+MSM = 3            # u64 set_id, u64 count, count * 32B scalars
+                   #                                   -> reply 97B point
 NTT = 4            # u8 flags (1=inverse, 2=coset), u64 n, n * 32B elements
                    #                                   -> reply n * 32B
 SHUTDOWN = 5
@@ -103,14 +106,32 @@ def encode_points(points):
         encode_point(p) for p in points)
 
 
-def decode_points(raw):
-    (n,) = struct.unpack_from("<Q", raw, 0)
+def decode_points(raw, off=0):
+    (n,) = struct.unpack_from("<Q", raw, off)
     out = []
-    off = 8
+    off += 8
     for _ in range(n):
         out.append(decode_point(raw[off:off + POINT_BYTES]))
         off += POINT_BYTES
     return out
+
+
+def encode_init_bases(set_id, points):
+    return struct.pack("<Q", set_id) + encode_points(points)
+
+
+def decode_init_bases(raw):
+    (set_id,) = struct.unpack_from("<Q", raw, 0)
+    return set_id, decode_points(raw, off=8)
+
+
+def encode_msm_request(set_id, scalars):
+    return struct.pack("<QQ", set_id, len(scalars)) + encode_scalars(scalars)
+
+
+def decode_msm_request(raw):
+    set_id, n = struct.unpack_from("<QQ", raw, 0)
+    return set_id, decode_scalars(raw[16:16 + n * FR_BYTES])
 
 
 def encode_fft_init(task_id, inverse, coset, n, r, c, rs, re, col_ranges):

@@ -84,7 +84,7 @@ class WorkerState:
         self.backend = backend
         self.config = config
         self.me = me
-        self.bases = None
+        self.base_sets = {}  # set_id -> bases (a worker can adopt ranges)
         self.lock = threading.Lock()
         self.domains = {}
         self.fft_tasks = {}
@@ -202,16 +202,16 @@ def _dispatch(conn, state, tag, payload):
     if tag == protocol.PING:
         conn.send(protocol.OK)
     elif tag == protocol.INIT_BASES:
-        bases = protocol.decode_points(payload)
+        set_id, bases = protocol.decode_init_bases(payload)
         with state.lock:
-            state.bases = bases
+            state.base_sets[set_id] = bases
         conn.send(protocol.OK)
     elif tag == protocol.MSM:
-        scalars = protocol.decode_scalars(payload)
+        set_id, scalars = protocol.decode_msm_request(payload)
         with state.lock:
-            bases = state.bases
+            bases = state.base_sets.get(set_id)
         if bases is None:
-            conn.send(protocol.ERR, b"no bases")
+            conn.send(protocol.ERR, b"no bases for set %d" % set_id)
             return None
         result = state.backend.msm(bases, scalars)
         conn.send(protocol.OK, protocol.encode_point(result))

@@ -77,6 +77,7 @@ def _spawn_fleet(tmp_path_factory, backend, port_base, startup_s):
                 time.sleep(0.3)
                 d = None
         assert d is not None, f"{backend} workers did not come up"
+        d.worker_procs = procs  # exposed for failure-injection tests
         yield d
         d.shutdown()
         for p in procs:
@@ -220,3 +221,55 @@ def test_jax_fleet_sharded_fft(jax_fleet, inverse, coset):
             want = P.fft(domain, values)
         got = jax_fleet.fft_dist(values, inverse=inverse, coset=coset)
         assert got == want, (n, inverse, coset)
+
+
+def test_msm_elastic_recovery(tmp_path_factory):
+    """Kill one worker mid-prove: its MSM range is re-provisioned onto a
+    healthy worker and the result is unchanged — the failure the reference
+    cannot survive (every RPC is .unwrap(), SURVEY.md §5: 'a worker crash
+    hangs or panics the prove')."""
+    gen = _spawn_fleet(tmp_path_factory, "python", 23000, 30)
+    d = next(gen)
+    try:
+        n = 64
+        bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD))
+                 for _ in range(n)]
+        scalars = [RNG.randrange(R_MOD) for _ in range(n)]
+        want = C.g1_msm(bases, scalars)
+        d.init_bases(bases)
+        assert d.msm(scalars) == want
+
+        d.worker_procs[1].kill()
+        d.worker_procs[1].wait(timeout=10)
+        assert d.msm(scalars) == want  # range 1 adopted by worker 0
+    finally:
+        gen.close()
+
+
+def test_msm_recovery_memoized_and_repeated(tmp_path_factory):
+    """After a death, later MSMs route straight to the adopting worker
+    (no re-dial / re-upload), and a fresh init_bases resets adoptions."""
+    gen = _spawn_fleet(tmp_path_factory, "python", 25000, 30)
+    d = gen.__next__()
+    try:
+        n = 32
+        bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD))
+                 for _ in range(n)]
+        scalars = [RNG.randrange(R_MOD) for _ in range(n)]
+        want = C.g1_msm(bases, scalars)
+        d.init_bases(bases)
+        d.worker_procs[1].kill()
+        d.worker_procs[1].wait(timeout=10)
+        assert d.msm(scalars) == want
+        assert d._adopted == {1: 0}
+        # memoized: repeated msm works and keeps the adoption
+        assert d.msm(scalars) == want
+        assert d._adopted == {1: 0}
+        # re-provisioning with one worker dead still succeeds lazily
+        bases2 = bases[::-1]
+        d.init_bases(bases2)
+        assert d._adopted == {}
+        assert d.msm(scalars) == C.g1_msm(bases2, scalars)
+        assert d._adopted == {1: 0}
+    finally:
+        gen.close()
