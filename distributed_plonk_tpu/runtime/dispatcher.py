@@ -192,10 +192,20 @@ class Dispatcher:
 
     def ntt(self, values, inverse=False, coset=False, worker=0):
         """Offload one whole NTT to a worker (per-polynomial task
-        parallelism, reference §2.3.3)."""
-        raw = self.workers[worker % len(self.workers)].call(
-            protocol.NTT, protocol.encode_ntt_request(values, inverse, coset))
-        return protocol.decode_scalars(raw)
+        parallelism, reference §2.3.3). NTTs are stateless, so a dead
+        worker is simply routed around: every other worker is tried before
+        giving up."""
+        k = len(self.workers)
+        payload = protocol.encode_ntt_request(values, inverse, coset)
+        last_err = None
+        for off in range(k):
+            try:
+                raw = self.workers[(worker + off) % k].call(
+                    protocol.NTT, payload)
+                return protocol.decode_scalars(raw)
+            except Exception as e:
+                last_err = e
+        raise RuntimeError("no worker could serve the NTT") from last_err
 
     def ntt_many(self, jobs):
         """Round-robin a batch of NTT jobs [(values, inverse, coset), ...]

@@ -273,3 +273,21 @@ def test_msm_recovery_memoized_and_repeated(tmp_path_factory):
         assert d._adopted == {1: 0}
     finally:
         gen.close()
+
+
+def test_ntt_routes_around_dead_worker(tmp_path_factory):
+    """Whole-poly NTT offload is stateless, so a dead worker is skipped."""
+    gen = _spawn_fleet(tmp_path_factory, "python", 27000, 30)
+    d = gen.__next__()
+    try:
+        n = 64
+        domain = P.Domain(n)
+        values = [RNG.randrange(R_MOD) for _ in range(n)]
+        d.worker_procs[0].kill()
+        d.worker_procs[0].wait(timeout=10)
+        # worker index 0 is the preferred target; must fall through to 1
+        assert d.ntt(values, worker=0) == P.fft(domain, values)
+        assert d.ntt_many([(values, True, False)]) == \
+            [P.ifft(domain, values)]
+    finally:
+        gen.close()
