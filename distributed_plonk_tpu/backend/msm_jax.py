@@ -32,6 +32,7 @@ and the number of reduction phases — and every memory access is regular.
 """
 
 import os
+import time
 from functools import partial
 
 import numpy as np
@@ -474,15 +475,23 @@ class MsmContext:
                 partial(digits_from_mont, c=self.c_batch,
                         padded_n=self.padded_n))
         self._chunk_fns = {}
+        self._chunk_calls = {}  # (nc, g) -> times executed (warm detection)
         self._finish_fns = {}
         self._merge_fn = jax.jit(
             lambda a, b: CJ.proj_add(tuple(a), tuple(b)))
 
-    # one device execution is kept under ~10^7 lane-adds (~25 s at the
-    # measured 2.5 us/lane-add): the tunneled runtime kills executions in
-    # the ~60 s range ("TPU worker process crashed"), observed for single
-    # calls at 2^19 points and above
+    # one device execution is kept under a lane-add budget: the tunneled
+    # runtime kills executions in the ~60 s range ("TPU worker process
+    # crashed"), observed for single calls at 2^19 points and above on the
+    # round-2 integer kernels. The budget is ADAPTIVE: the first chunk is
+    # timed (fenced by a tiny transfer) and subsequent chunks resize toward
+    # DPT_MSM_CALL_S seconds/call — the f32 kernel rewrite moved the
+    # adds/s rate by an order of magnitude, and a static budget would
+    # either waste dispatches or trip the kill limit.
     _CALL_ADDS = int(os.environ.get("DPT_MSM_CALL_ADDS", "8000000"))
+    _CALL_TARGET_S = float(os.environ.get("DPT_MSM_CALL_S", "20"))
+    _CALL_ADDS_MAX = int(os.environ.get("DPT_MSM_CALL_ADDS_MAX",
+                                        str(1 << 28)))
 
     def _chunk_fn(self, nc, group):
         key = (nc, group)
@@ -498,21 +507,54 @@ class MsmContext:
                 partial(finish_batch, batch=batch, signed=self.signed))
         return self._finish_fns[batch]
 
+    # adds/s measured from the first fenced chunk call; class-level so every
+    # context on the process shares the calibration
+    _measured_adds_per_s = None
+
+    def _chunk_lanes(self, B, W):
+        """Current per-call point budget (1024-aligned)."""
+        budget = self._CALL_ADDS
+        if MsmContext._measured_adds_per_s is not None:
+            budget = min(self._CALL_ADDS_MAX,
+                         int(MsmContext._measured_adds_per_s
+                             * self._CALL_TARGET_S))
+        return max(1024, (budget // (B * W)) & ~1023)
+
     def _exec_chunked(self, digits):
         """digits (B, W, padded_n) -> ((24, B),)*3 totals, in as many
         device calls as the per-call budget requires: per-chunk bucket
         accumulation, cheap cross-chunk plane merges, one finish tail."""
         B, W, n = digits.shape
-        chunk = max(1024, (self._CALL_ADDS // (B * W)) & ~1023)
         ax, ay, ainf = self.point
         acc = None
-        for i0 in range(0, n, chunk):
+        i0 = 0
+        while i0 < n:
+            chunk = self._chunk_lanes(B, W)
             nc = min(chunk, n - i0)
             g = _group_size_batch(nc, B, SCALAR_BITS // W, signed=self.signed)
-            part = self._chunk_fn(nc, g)(
-                ax[:, i0:i0 + nc], ay[:, i0:i0 + nc], ainf[i0:i0 + nc],
-                digits[:, :, i0:i0 + nc])
+            fn = self._chunk_fn(nc, g)
+            # calibrate once, on a WARM shape only: a first call's
+            # wall-clock is dominated by XLA compilation and would wildly
+            # under-read the device rate
+            warm = self._chunk_calls.get((nc, g), 0) > 0
+            calibrate = (MsmContext._measured_adds_per_s is None
+                         and nc >= 8192 and warm)
+            if calibrate:
+                if acc is not None:  # drain queued async work first, or
+                    np.asarray(acc[0][:1, :1, :1])  # dt covers prior chunks
+                t0 = time.perf_counter()
+            part = fn(ax[:, i0:i0 + nc], ay[:, i0:i0 + nc], ainf[i0:i0 + nc],
+                      digits[:, :, i0:i0 + nc])
+            if calibrate:
+                np.asarray(part[0][:1, :1, :1])  # fence (tiny transfer)
+                # clamp: a sub-latency reading still LATCHES (at an
+                # optimistic rate bounded by _CALL_ADDS_MAX) so the fence
+                # never re-runs on later chunks
+                dt = max(time.perf_counter() - t0, 0.02)
+                MsmContext._measured_adds_per_s = B * W * nc / dt
+            self._chunk_calls[(nc, g)] = self._chunk_calls.get((nc, g), 0) + 1
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
+            i0 += nc
         return self._finish_fn(B)(*acc)
 
     def msm(self, scalars):
