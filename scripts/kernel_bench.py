@@ -56,6 +56,31 @@ def bench_mont_mul(spec_name, n, chain=8, reps=3):
             "ns_per_mul": round(1e9 / per_s, 2)}
 
 
+def bench_msm(log_n, reps=2):
+    """Warm MSM at 2^log_n points (distinct-base tiling like the
+    reference's micro-test, src/dispatcher.rs:188-196)."""
+    import random
+    from distributed_plonk_tpu import curve as C
+    from distributed_plonk_tpu.constants import R_MOD
+    from distributed_plonk_tpu.backend.msm_jax import MsmContext
+
+    n = 1 << log_n
+    rng = random.Random(3)
+    distinct = [C.g1_mul(C.G1_GEN, rng.randrange(1, R_MOD))
+                for _ in range(1 << 11)]
+    bases = (distinct * (n // len(distinct) + 1))[:n]
+    ctx = MsmContext(bases)
+    scalars = [rng.randrange(R_MOD) for _ in range(n)]
+    ctx.msm(scalars)  # compile + warm + adaptive-chunk calibration
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ctx.msm(scalars)
+    dt = (time.perf_counter() - t0) / reps
+    return {"kernel": f"msm_2p{log_n}", "s": round(dt, 3),
+            "points_per_s": round(n / dt),
+            "adds_per_s_calibrated": MsmContext._measured_adds_per_s}
+
+
 def bench_ntt(log_n, reps=3):
     from distributed_plonk_tpu.backend import ntt_jax
 
@@ -86,6 +111,9 @@ def main():
         out["fq"] = bench_mont_mul("fq", 1 << 18)
     if what in ("ntt", "all"):
         out["ntt"] = bench_ntt(20)
+    if what in ("msm", "all"):
+        out["msm_2p16"] = bench_msm(16)
+        out["msm_2p20"] = bench_msm(20, reps=1)
     print(json.dumps(out))
 
 
