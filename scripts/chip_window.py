@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Opportunistic chip measurement for a FLAPPING relay.
+
+The axon relay has been observed to die for hours and recover for
+minutes. This script probes, then runs an ESCALATING series of
+measurements — smallest/most-valuable first — printing one JSON line per
+completed step immediately (flushed), so however short the alive window
+is, whatever finished is captured. Every step is independently
+try/except'd; a mid-step hang is bounded by the caller's timeout.
+
+Usage: python scripts/chip_window.py   (ambient axon env)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def sync(x):
+    np.asarray(x[:1, :1] if getattr(x, "ndim", 1) >= 2 else x[:1])
+
+
+def step_probe():
+    import jax.numpy as jnp
+    t0 = time.perf_counter()
+    v = int(jnp.arange(8).sum())
+    assert v == 28
+    return {"probe_s": round(time.perf_counter() - t0, 2)}
+
+
+def step_mont_mul(log_n=18, chain=2, reps=3):
+    import jax
+    from distributed_plonk_tpu.backend import field_jax as FJ
+
+    n = 1 << log_n
+
+    @jax.jit
+    def f(a, b):
+        acc = a
+        for _ in range(chain):
+            acc = FJ.mont_mul(FJ.FR, acc, b)
+        return acc
+
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
+    b = rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
+    t0 = time.perf_counter()
+    sync(f(a, b))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(a, b)
+    sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    per_s = n * chain / dt
+    return {"kernel": "mont_mul_fr", "n": n, "chain": chain,
+            "compile_s": round(compile_s, 1), "s_per_call": round(dt, 4),
+            "mul_per_s": round(per_s), "ns_per_mul": round(1e9 / per_s, 2)}
+
+
+def step_ntt(log_n, reps=3):
+    from distributed_plonk_tpu.backend import ntt_jax
+
+    n = 1 << log_n
+    plan = ntt_jax.get_plan(n)
+    kernel = plan.kernel()
+    rng = np.random.default_rng(2)
+    v = rng.integers(0, 1 << 16, size=(16, n), dtype=np.uint32)
+    t0 = time.perf_counter()
+    sync(kernel(v))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = kernel(v)
+    sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    return {"kernel": f"ntt_2p{log_n}", "compile_s": round(compile_s, 1),
+            "s": round(dt, 4), "elements_per_s": round(n / dt)}
+
+
+def step_msm(log_n, reps=1):
+    import random
+    from distributed_plonk_tpu import curve as C
+    from distributed_plonk_tpu.constants import R_MOD
+    from distributed_plonk_tpu.backend.msm_jax import MsmContext
+
+    n = 1 << log_n
+    rng = random.Random(3)
+    distinct = [C.g1_mul(C.G1_GEN, rng.randrange(1, R_MOD))
+                for _ in range(1 << 10)]
+    bases = (distinct * (n // len(distinct) + 1))[:n]
+    ctx = MsmContext(bases)
+    scalars = [rng.randrange(R_MOD) for _ in range(n)]
+    t0 = time.perf_counter()
+    ctx.msm(scalars)  # compile + warm + calibration
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ctx.msm(scalars)
+    dt = (time.perf_counter() - t0) / reps
+    return {"kernel": f"msm_2p{log_n}", "compile_plus_first_s": round(compile_s, 1),
+            "s": round(dt, 3), "points_per_s": round(n / dt),
+            "adds_per_s_calibrated": MsmContext._measured_adds_per_s}
+
+
+STEPS = [
+    ("probe", step_probe),
+    ("mont_mul_fr_2p18", step_mont_mul),
+    ("ntt_2p12", lambda: step_ntt(12)),
+    ("ntt_2p20", lambda: step_ntt(20)),
+    ("msm_2p14", lambda: step_msm(14, reps=2)),
+    ("msm_2p20", lambda: step_msm(20)),
+]
+
+
+def main():
+    for name, fn in STEPS:
+        t0 = time.perf_counter()
+        try:
+            res = fn()
+            res["step"] = name
+            res["total_s"] = round(time.perf_counter() - t0, 1)
+            emit(res)
+        except Exception as e:
+            emit({"step": name, "error": repr(e)[:300],
+                  "total_s": round(time.perf_counter() - t0, 1)})
+            break  # a dead relay fails everything downstream
+
+
+if __name__ == "__main__":
+    main()
