@@ -72,6 +72,11 @@ class JaxBackend:
     def coset_ifft(self, domain, values):
         return ntt_jax.get_plan(domain.size).run_ints(values, inverse=True, coset=True)
 
+    def _make_msm_ctx(self, bases):
+        """MSM context factory hook (the mesh backend overrides this to
+        build a mesh-sharded context; the caching in _ctx is shared)."""
+        return MsmContext(bases)
+
     def _ctx(self, bases):
         # keyed by identity; the bases reference is retained so the id can
         # never be recycled by a different object while cached. Capped like
@@ -86,7 +91,7 @@ class JaxBackend:
         with self._cache_lock:
             hit = self._msm_ctxs.get(key)
         if hit is None:
-            built = MsmContext(bases)
+            built = self._make_msm_ctx(bases)
             with self._cache_lock:
                 if key not in self._msm_ctxs:
                     self._cache_put(self._msm_ctxs, key, (bases, built))
@@ -106,9 +111,15 @@ class JaxBackend:
 
     # --- poly-handle protocol: handles are (16, L) Montgomery arrays --------
 
+    def _lift_arr(self, arr):
+        """Host (16, K) limb array -> device array. Placement hook: the
+        single-device backend uses the default device; the mesh backend
+        overrides this to device_put with a sharded layout."""
+        return jnp.asarray(arr)
+
     def lift(self, values):
         self.lifts += 1
-        return jnp.asarray(PJ.lift(values))
+        return self._lift_arr(PJ.lift(values))
 
     def lift_many(self, value_lists):
         """Upload B equal-length int lists as ONE transfer -> B handles
@@ -118,7 +129,7 @@ class JaxBackend:
         assert all(len(v) == n for v in value_lists)
         flat = [x for vs in value_lists for x in vs]
         self.lifts += 1
-        h = jnp.asarray(PJ.lift(flat))
+        h = self._lift_arr(PJ.lift(flat))
         return [h[:, i * n:(i + 1) * n] for i in range(len(value_lists))]
 
     def lower(self, h):
@@ -143,8 +154,8 @@ class JaxBackend:
             hit = self._pk_polys.get(key)
         if hit is None:
             self.lifts += 1  # O(n) upload: proving-key polys, once per pk
-            sel = [jnp.asarray(PJ.lift(s)) for s in pk.selectors]
-            sig = [jnp.asarray(PJ.lift(s)) for s in pk.sigmas]
+            sel = [self._lift_arr(PJ.lift(s)) for s in pk.selectors]
+            sig = [self._lift_arr(PJ.lift(s)) for s in pk.sigmas]
             with self._cache_lock:
                 if key not in self._pk_polys:
                     self._cache_put(self._pk_polys, key, (pk, sel, sig))
@@ -272,17 +283,22 @@ class JaxBackend:
         w = NUM_WIRE_TYPES
         wire_vals = [circuit.wire_values(i) for i in range(w)]
         flat = [v for vals in wire_vals for v in vals]
-        wires = jnp.asarray(PJ.lift(flat)).reshape(FR_LIMBS, w, n)
+        wires = self._lift_tab(PJ.lift(flat), w, n)
         id_flat = [circuit.extended_id_permutation[i][j]
                    for i in range(w) for j in range(n)]
-        id_tab = jnp.asarray(PJ.lift(id_flat)).reshape(FR_LIMBS, w, n)
+        id_tab = self._lift_tab(PJ.lift(id_flat), w, n)
         sig_flat = []
         for i in range(w):
             for j in range(n):
                 pi, pj = circuit.wire_permutation[i][j]
                 sig_flat.append(circuit.extended_id_permutation[pi][pj])
-        sig_tab = jnp.asarray(PJ.lift(sig_flat)).reshape(FR_LIMBS, w, n)
+        sig_tab = self._lift_tab(PJ.lift(sig_flat), w, n)
         return {"wires": wires, "id": id_tab, "sig": sig_tab, "n": n}
+
+    def _lift_tab(self, arr, w, n):
+        """Host (16, w*n) limb array -> (16, w, n) device table (placement
+        hook, like _lift_arr)."""
+        return jnp.asarray(arr).reshape(FR_LIMBS, w, n)
 
     def perm_product(self, circuit, beta, gamma, n):
         tabs = self._circuit_tables(circuit)

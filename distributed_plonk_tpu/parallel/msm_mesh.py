@@ -10,25 +10,51 @@ scanned fold body the group fold uses — replacing the reference's
 host-side sum-reduce of partial totals (dispatcher2.rs:888-890). (G1
 addition is not a ring sum, so `psum` does not apply; the all_gather+fold
 is the collective equivalent.) A single finish machine then turns the
-globally folded buckets into the result, so the whole mesh program
-compiles the same THREE complete-projective-add bodies (RCB15; 2
-stacked-lane multiplier instances each) as the single-device path — the
-structure that keeps the multi-chip dry-run inside the compile budget on
-a virtual CPU mesh.
+globally folded buckets into the result.
+
+This is the full prover commitment surface, not just a host-scalar demo:
+like the single-device MsmContext, the mesh context
+
+  - runs the SIGNED radix-256 batched pipeline (128 buckets, sign folded
+    into y) whenever the per-device slice is large enough, falling back
+    to the unsigned small-window scan only for tiny slices where the
+    signed recode has no overflow margin;
+  - accepts (16, L) MONTGOMERY poly handles and extracts digits on
+    device (`msm_mont_limbs_many`), so a mesh-backed prove commits
+    device-resident polynomials without a host round-trip;
+  - batches B polynomials through shared scan steps and chunks the
+    point range so one device execution stays under the per-call budget
+    (the tunneled runtime kills ~60 s executions).
+
+Data layout: points live as (24, D, local) arrays sharded on the device
+axis — device d owns the contiguous base range [d*local, (d+1)*local) —
+so chunk slices along the LOCAL axis never reshard.
 """
 
+import os
 from functools import partial
 
+import numpy as np
 import jax
+import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from ..constants import FQ_LIMBS
 from ..backend import msm_jax
+from ..backend import curve_jax as CJ
+from ..backend.msm_jax import (
+    SCALAR_BITS, DeviceCommitKey, window_bits, _group_size_batch,
+    bucket_planes_batch, bucket_planes_batch_signed, fold_planes,
+    finish_batch, digits_of_scalars, signed_digits_of_scalars,
+    digits_from_mont, signed_digits_from_mont, points_to_device,
+    _proj_limbs_to_affine,
+)
 from .mesh import SHARD_AXIS
 
 
@@ -37,75 +63,186 @@ class MeshMsmContext:
     1/D range of the SRS (the v1 init semantics the rebuild standardizes
     on, /root/reference/src/dispatcher.rs:572-578)."""
 
-    def __init__(self, mesh, bases_affine):
+    # per-call lane-add budget PER DEVICE (all devices run concurrently);
+    # same knob semantics as MsmContext's chunking
+    _CALL_ADDS = int(os.environ.get("DPT_MSM_CALL_ADDS", "8000000"))
+
+    def __init__(self, mesh, bases):
         self.mesh = mesh
-        d = mesh.devices.size
-        n = len(bases_affine)
+        self.d = d = mesh.devices.size
+        n = len(bases)
         self.n = n
-        # pad so every shard is non-trivially groupable
-        pad = (-n) % (2 * d)
-        self.padded_n = n + pad
+        # pad so the local slice is even-sized and groupable; identity
+        # padding columns never change the sum
+        self.padded_n = n + (-n) % (16 * d)
         self.local_n = self.padded_n // d
-        self.group = msm_jax._group_size(self.local_n)
-        # Pippenger window size from the per-device slice (what each
-        # device's bucket pipeline actually sees)
-        self.c = msm_jax.window_bits(self.local_n)
+        # window choice from the PER-DEVICE slice (what each device's
+        # bucket pipeline actually sees): signed radix-256 once the slice
+        # is big enough, like MsmContext.c_batch
+        self.c = 8 if self.local_n >= 256 else window_bits(self.local_n)
+        self.signed = self.c == 8
+        self.windows = SCALAR_BITS // self.c
 
-        # the mesh scan keeps unsigned digits (tiny dry-run shapes use
-        # c < 8 where the signed recode has no overflow margin) but rides
-        # the same complete-projective bucket pipeline as the single-chip
-        # path; bases stay HOST numpy so the only device traffic is the
-        # sharded put
-        ax, ay, ainf = msm_jax.points_to_device(bases_affine, pad)
-        shard_nd = jax.sharding.NamedSharding(mesh, P(None, SHARD_AXIS))
-        inf_nd = jax.sharding.NamedSharding(mesh, P(SHARD_AXIS))
-        self.point = (jax.device_put(ax, shard_nd),
-                      jax.device_put(ay, shard_nd),
-                      jax.device_put(ainf, inf_nd))
+        pad = self.padded_n - n
+        if isinstance(bases, DeviceCommitKey):
+            # device-built SRS (Jacobian, arbitrary Z): normalize once via
+            # batched inversion on whatever device it lives on, then
+            # reshard onto the mesh
+            point = bases.point
+            if pad:
+                point = tuple(jnp.pad(p, ((0, 0), (0, pad))) for p in point)
+            ax, ay, ainf = CJ.batch_to_affine(point)
+        else:
+            ax, ay, ainf = points_to_device(bases, pad)  # host numpy
 
-        shard = P(None, SHARD_AXIS)
+        pt_sh = NamedSharding(mesh, P(None, SHARD_AXIS, None))
+        inf_sh = NamedSharding(mesh, P(SHARD_AXIS, None))
+        resh = (np.reshape if isinstance(ax, np.ndarray) else jnp.reshape)
+        self.point = (
+            jax.device_put(resh(ax, (FQ_LIMBS, d, self.local_n)), pt_sh),
+            jax.device_put(resh(ay, (FQ_LIMBS, d, self.local_n)), pt_sh),
+            jax.device_put(resh(ainf, (d, self.local_n)), inf_sh),
+        )
 
-        def body(ax, ay, ainf, digits):
-            # local slice: (24, local_n); digits (W, local_n)
-            wb = jax.vmap(partial(msm_jax._bucket_scan, group=self.group,
-                                  n_buckets=1 << self.c),
-                          in_axes=(None, None, None, 0))(ax, ay, ainf, digits)
-            planes = tuple(b.transpose(2, 1, 0, 3) for b in wb)
-            local = msm_jax.fold_planes(*planes)  # (24, W, B) per device
-            # fold bucket planes across the mesh on device (the reference
-            # folds partial totals on the dispatcher host instead); the
-            # fold body is identical to the group fold's -> compiled once
-            gathered = tuple(lax.all_gather(b, SHARD_AXIS) for b in local)
-            return msm_jax.fold_planes(*gathered)
+        self._digits_sh = NamedSharding(mesh, P(None, None, SHARD_AXIS, None))
+        self._digits_fns = {}
+        self._chunk_fns = {}
+        self._finish_fns = {}
+        self._merge_fn = jax.jit(lambda a, b: CJ.proj_add(tuple(a), tuple(b)))
 
-        # check_vma=False: the all_gather+fold makes the outputs replicated
-        # in value, which the varying-axes checker cannot infer statically
-        self._fn = jax.jit(_shard_map(
-            body, mesh=mesh,
-            in_specs=(shard, shard, P(SHARD_AXIS), shard),
-            out_specs=(P(None, None, None),) * 3, check_vma=False))
-        # the O(windows*buckets) finish tail runs on the replicated fold
-        # result OUTSIDE the mesh program: one single-device compile (shared
-        # with MsmContext's pipeline via the persistent cache) instead of an
-        # 8-partition one
-        self._finish = jax.jit(msm_jax.finish)
+    # --- digit extraction ----------------------------------------------------
+
+    def _digits_np(self, scalars):
+        """Host ints -> (W, D, local) numpy digits."""
+        if self.signed:
+            dg = signed_digits_of_scalars(scalars, self.padded_n)
+        else:
+            dg = digits_of_scalars(scalars, self.padded_n, self.c)
+        return dg.reshape(self.windows, self.d, self.local_n)
+
+    def _digits_of_handles(self, hs):
+        """B Montgomery (16, L) handles -> (B, W, D, local) device digits,
+        extracted on device (no host round-trip before a commitment)."""
+        key = tuple(h.shape[1] for h in hs)
+        fn = self._digits_fns.get(key)
+        if fn is None:
+            W, d, loc = self.windows, self.d, self.local_n
+
+            def build(handles):
+                outs = []
+                for h in handles:
+                    if self.signed:
+                        dg = signed_digits_from_mont(h, self.padded_n)
+                    else:
+                        dg = digits_from_mont(h, self.c, self.padded_n)
+                    outs.append(dg.reshape(W, d, loc))
+                return jnp.stack(outs)
+
+            fn = jax.jit(build, out_shardings=self._digits_sh)
+            self._digits_fns[key] = fn
+        return fn(list(hs))
+
+    # --- sharded bucket accumulation ----------------------------------------
+
+    def _chunk_fn(self, jc, group, B):
+        """shard_map'd program: per-device bucket planes on a jc-wide local
+        chunk, then cross-device all_gather + fold -> replicated planes."""
+        key = (jc, group, B)
+        if key not in self._chunk_fns:
+            scan = (bucket_planes_batch_signed if self.signed
+                    else bucket_planes_batch)
+
+            def body(ax, ay, ainf, digits):
+                # local block: ax/ay (24, 1, jc), ainf (1, jc),
+                # digits (B, W, 1, jc)
+                acc = scan(ax[:, 0], ay[:, 0], ainf[0],
+                           digits[:, :, 0], group=group)
+                # fold bucket planes across the mesh on device (the
+                # reference folds partial totals on the dispatcher host,
+                # dispatcher2.rs:888-890); the fold body is identical to
+                # the group fold's -> compiled once
+                gathered = tuple(lax.all_gather(b, SHARD_AXIS) for b in acc)
+                return fold_planes(*gathered)
+
+            # check_vma=False: the all_gather+fold makes the outputs
+            # replicated in value, which the varying-axes checker cannot
+            # infer statically
+            self._chunk_fns[key] = jax.jit(_shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(None, SHARD_AXIS, None), P(None, SHARD_AXIS, None),
+                          P(SHARD_AXIS, None), P(None, None, SHARD_AXIS, None)),
+                out_specs=(P(None, None, None),) * 3, check_vma=False))
+        return self._chunk_fns[key]
+
+    def _finish_fn(self, batch):
+        if batch not in self._finish_fns:
+            self._finish_fns[batch] = jax.jit(
+                partial(finish_batch, batch=batch, signed=self.signed))
+        return self._finish_fns[batch]
+
+    def _exec(self, digits):
+        """digits (B, W, D, local) -> B affine points (host ints/None)."""
+        B = digits.shape[0]
+        W = self.windows
+        ax, ay, ainf = self.point
+        chunk = max(16, (self._CALL_ADDS // (B * W)) & ~15)
+        acc = None
+        j0 = 0
+        while j0 < self.local_n:
+            jc = min(chunk, self.local_n - j0)
+            g = _group_size_batch(jc, B, self.c, signed=self.signed)
+            fn = self._chunk_fn(jc, g, B)
+            part = fn(ax[:, :, j0:j0 + jc], ay[:, :, j0:j0 + jc],
+                      ainf[:, j0:j0 + jc], digits[:, :, :, j0:j0 + jc])
+            if acc is None:
+                acc = part
+            else:
+                acc = tuple(self._merge_fn(acc, part))
+            j0 += jc
+        # commit the replicated fold result to ONE device before the
+        # O(W * buckets) finish tail: otherwise the finish jit inherits the
+        # D-way replicated sharding and every device redundantly executes
+        # the whole tail. Under multi-controller the global array is not
+        # fully addressable, so each process pulls its LOCAL replica
+        # (identical by construction).
+        dev = next((dv for dv in self.mesh.devices.ravel()
+                    if dv.process_index == jax.process_index()),
+                   self.mesh.devices.ravel()[0])
+        acc = tuple(jax.device_put(a.addressable_data(0), dev) for a in acc)
+        tx, ty, tz = self._finish_fn(B)(*acc)
+        tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
+        return [_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
+                for j in range(B)]
+
+    # --- public surface (mirrors MsmContext) --------------------------------
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
-        assert len(scalars) <= self.n
-        digits = msm_jax.digits_of_scalars(scalars, self.padded_n, self.c)
-        ax, ay, ainf = self.point
-        buckets = self._fn(ax, ay, ainf, digits)
-        # commit the replicated fold result to ONE device: otherwise the
-        # finish jit inherits the 8-way replicated sharding and every
-        # device redundantly executes the whole tail. Under multi-controller
-        # the global array is not fully addressable, so each process pulls
-        # its LOCAL replica (identical by construction) and runs the tail
-        # on its own first device.
-        dev = next((d for d in self.mesh.devices.ravel()
-                    if d.process_index == jax.process_index()),
-                   self.mesh.devices.ravel()[0])
-        buckets = tuple(jax.device_put(b.addressable_data(0), dev)
-                        for b in buckets)
-        tx, ty, tz = self._finish(*buckets)
-        return msm_jax._proj_limbs_to_affine(tx, ty, tz)
+        return self.msm_many([scalars])[0]
+
+    def msm_many(self, scalar_lists):
+        """B MSMs over host int scalar lists in one batched mesh launch."""
+        for s in scalar_lists:
+            assert len(s) <= self.n
+        digits = np.stack([self._digits_np(s) for s in scalar_lists])
+        return self._exec(jax.device_put(digits, self._digits_sh))
+
+    def msm_mont_limbs(self, h):
+        """Commit a (16, L <= padded_n) Montgomery coefficient handle."""
+        return self.msm_mont_limbs_many([h])[0]
+
+    # like MsmContext: fixed chunk width keeps the compiled batch-shape set
+    # small across prover rounds (8, then the 5/2-size residuals)
+    _BATCH_CHUNK = int(os.environ.get("DPT_MSM_BATCH", "8"))
+
+    def msm_mont_limbs_many(self, hs):
+        """Commit B Montgomery coefficient handles; digit extraction and
+        bucket accumulation run sharded on the mesh, only the resulting
+        group elements return to the host (for the transcript)."""
+        for h in hs:
+            assert h.shape[1] <= self.padded_n, (h.shape, self.padded_n)
+        out = []
+        for i in range(0, len(hs), self._BATCH_CHUNK):
+            digits = self._digits_of_handles(hs[i:i + self._BATCH_CHUNK])
+            out.extend(self._exec(digits))
+        return out

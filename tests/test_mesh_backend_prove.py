@@ -1,0 +1,65 @@
+"""End-to-end prove on the MESH backend (8-device virtual CPU mesh).
+
+The mesh analog of the reference's `test2` (fully-distributed prove,
+/root/reference/src/dispatcher2.rs:1273-1295): every NTT rides the
+sharded 4-step kernel (single all_to_all), every commitment the
+range-sharded signed Pippenger with on-device plane fold, and the round
+math runs SPMD-partitioned on sharded handles — and the proof must be
+bit-identical to the host-oracle proof (same rng) and verify, the
+reference's distributed == single-node invariant (SURVEY.md §4).
+"""
+
+import random
+
+import pytest
+
+from distributed_plonk_tpu.prover import prove
+from distributed_plonk_tpu.verifier import verify
+from distributed_plonk_tpu.parallel.mesh import make_mesh
+from distributed_plonk_tpu.parallel.mesh_backend import MeshBackend
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8, platform="cpu")
+
+
+def test_mesh_prove_verifies_and_matches_oracle(proven, mesh8):
+    ckt, pk, vk, proof_host = proven
+    be = MeshBackend(mesh8)
+    proof_mesh = prove(random.Random(1), ckt, pk, be)
+    assert verify(vk, ckt.public_input(), proof_mesh, rng=random.Random(2))
+
+    # same device-residency budget as the single-device backend: pk +
+    # circuit tables + public input up, one batched round-4 eval down
+    assert be.lifts == 3, be.lifts
+    assert be.lowers == 1, be.lowers
+
+    assert proof_mesh.wires_poly_comms == proof_host.wires_poly_comms
+    assert proof_mesh.prod_perm_poly_comm == proof_host.prod_perm_poly_comm
+    assert proof_mesh.split_quot_poly_comms == proof_host.split_quot_poly_comms
+    assert proof_mesh.opening_proof == proof_host.opening_proof
+    assert proof_mesh.shifted_opening_proof == proof_host.shifted_opening_proof
+    assert proof_mesh.wires_evals == proof_host.wires_evals
+    assert proof_mesh.wire_sigma_evals == proof_host.wire_sigma_evals
+    assert proof_mesh.perm_next_eval == proof_host.perm_next_eval
+
+
+def test_mesh_preprocess_matches_oracle(proven, mesh8):
+    """Device preprocess through the mesh backend: selector/sigma
+    commitments (the vk) must equal the host preprocess byte-for-byte
+    (mirrors PlonkKzgSnark::preprocess, reference dispatcher2.rs:1280)."""
+    from distributed_plonk_tpu import kzg
+
+    ckt, pk_host, vk_host, _ = proven
+    be = MeshBackend(mesh8)
+    srs = kzg.universal_setup(ckt.n + 3, tau=0xDEADBEEF)
+    pk, vk = kzg.preprocess(srs, ckt, backend=be)
+    assert vk.selector_comms == vk_host.selector_comms
+    assert vk.sigma_comms == vk_host.sigma_comms
+
+    # and a prove with the mesh-preprocessed pk (device-registered pk
+    # handles) still matches the oracle proof
+    proof = prove(random.Random(1), ckt, pk, be)
+    assert proof.opening_proof == (prove(random.Random(1), ckt, pk_host,
+                                         be).opening_proof)
