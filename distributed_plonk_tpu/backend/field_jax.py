@@ -127,7 +127,8 @@ def _bcast_const(limbs, ndim):
 
 
 def _carry_sweep(cols):
-    """Exact carry propagation. cols: (K, *batch) uint32 with entries < 2^23.
+    """Exact carry propagation. cols: (K, *batch) uint32 (ANY u32 entries:
+    the f32 path feeds combined even+odd byte columns up to ~2^30 here).
 
     Returns (limbs, carry_out): limbs (K, *batch) all < 2^16, carry_out the
     overflow past the top limb (zero whenever the caller's bound guarantees
@@ -183,14 +184,62 @@ def _skew_colsum(m, shift, dtype=jnp.uint32):
     return jnp.sum(skewed, axis=0, dtype=dtype)  # (W-1, *batch)
 
 
-# float limb products (DPT_FIELD_MUL=f32, default) vs the round-2 u32 path:
-# TPU vector units have no native 32-bit integer multiply — the measured u32
-# multiply rate (~38 Gops/s on v5e) is an emulation ~50x below the f32 FMA
-# rate — so limb products are computed on 8-bit sub-limbs in f32 (exact:
-# products <= 255^2, anti-diagonal sums <= 96*255^2 < 2^23 < 2^24) and the
-# two constant products of Montgomery SOS additionally become bf16 MXU
-# matmuls against banded Toeplitz matrices (_toeplitz_bytes).
-_F32_MUL = os.environ.get("DPT_FIELD_MUL", "f32") != "u32"
+# Multiplier path (DPT_FIELD_MUL):
+#   auto (default): the Pallas fused kernel on TPU for wide shapes, the
+#       XLA f32 byte-product path otherwise. Measured round 4 (v5e): the
+#       XLA paths materialize their byte-column transients to HBM
+#       (~18 KB/lane/mul — the MSM's measured traffic wall and a 24 GB
+#       OOM at 2^18-lane calls); the Pallas kernel keeps them in VMEM and
+#       runs 42 ns/mul Fr / 85 ns/mul Fq, ~10-40x the XLA paths.
+#   f32: XLA byte-product path only (f32 VPU products + bf16 MXU Toeplitz
+#       constant products).
+#   u32: the round-2 integer path (u32 multiply is an emulation ~50x
+#       below the f32 FMA rate; kept as a reference oracle).
+#   pallas: force the Pallas kernel for any wide-enough shape (interpret
+#       mode off-TPU — slow, test-only).
+_MUL_MODE = os.environ.get("DPT_FIELD_MUL", "auto")
+_F32_MUL = _MUL_MODE != "u32"
+
+# below this many lanes the per-call overhead of a pallas kernel exceeds
+# the XLA path's cost (scalar/narrow shapes: transcript scalars, finish
+# tails) — those stay on the fused-XLA path
+_PALLAS_MIN_LANES = int(os.environ.get("DPT_PALLAS_MIN_LANES", "2048"))
+
+
+import contextlib
+import threading
+
+_pallas_off = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_disabled():
+    """Disable the Pallas dispatch for mont_muls traced inside this block.
+
+    Used by MeshBackend around its GSPMD-auto-sharded round math: a
+    pallas_call has no SPMD partitioning rule, so letting the partitioner
+    meet one on a sharded operand outside shard_map would either fail or
+    silently all-gather the shards. The explicit shard_map paths (mesh
+    NTT/MSM) are per-device local and keep the kernel."""
+    prev = getattr(_pallas_off, "v", False)
+    _pallas_off.v = True
+    try:
+        yield
+    finally:
+        _pallas_off.v = prev
+
+
+def _use_pallas(shape):
+    if _MUL_MODE in ("u32", "f32") or getattr(_pallas_off, "v", False):
+        return False
+    lanes = 1
+    for d in shape[1:]:
+        lanes *= d
+    if lanes < _PALLAS_MIN_LANES:
+        return False
+    if _MUL_MODE == "pallas":
+        return True
+    return jax.default_backend() == "tpu"
 
 
 def _bytes_f32(a):
@@ -271,9 +320,10 @@ def _sweep_pair(cols_a, cols_b):
 def _cond_sub_mod(spec, cols):
     """Value of `cols` reduced once: v - p if v >= p else v  (v < 2p).
 
-    Takes UNCARRIED columns (< 2^23 each) and resolves both candidates with
-    a single paired sweep: lane2 adds 2^(16L) - p, whose carry-out flags
-    v >= p.
+    Takes UNCARRIED columns (any u32 entries — the sweep's pre-add bound
+    is per-limb, not per-column; see _carry_sweep) and resolves both
+    candidates with a single paired sweep: lane2 adds 2^(16L) - p, whose
+    carry-out flags v >= p.
     """
     negp = _bcast_const(spec.negmod_limbs, cols.ndim)
     (t, d), (_, c2) = _sweep_pair(cols, cols + negp)
@@ -312,7 +362,13 @@ def mont_mul(spec, a, b):
     carry-out of t + m*p (those limbs are identically 0 mod R); and the
     final reduce of the uncarried high half (t + m*p)/R, folded into
     _cond_sub_mod's paired sweep.
+
+    Wide shapes on TPU dispatch to the Pallas fused kernel
+    (field_pallas.py) — same algorithm, intermediates in VMEM.
     """
+    if _use_pallas(jnp.broadcast_shapes(a.shape, b.shape)):
+        from . import field_pallas as FP
+        return FP.mont_mul(spec, a, b)
     l = spec.n_limbs
     t_cols = _mul_columns(a, b, 2 * l)  # a*b < p^2, uncarried
     t_lo, c_t = _carry_sweep(t_cols[:l])  # exact t mod R + carry into col l

@@ -1,0 +1,233 @@
+"""Pallas fused Montgomery multiplier: the whole SOS product in VMEM.
+
+WHY (measured on a v5e through scripts/msm_ab.py + BASELINE.md round 4):
+the XLA-level f32 mont_mul materializes its byte-product column tensor to
+HBM (~18 KB per lane per multiply — a 2^18-lane call allocates 24 GB and
+OOMs the chip), which makes every projective add ~12 x 18 KB of HBM
+traffic. The measured MSM ceiling (~370-620k lane-adds/s regardless of
+width) is exactly that traffic bound. This kernel keeps ALL intermediates
+(byte rows, product columns, carry sweeps) in VMEM scratch: HBM traffic
+per multiply drops to the operands + result (~300 B/lane), a ~60x cut.
+
+HOW: one grid step processes a (n_limbs, LANE_TILE) block of each
+operand. The schoolbook byte product is NOT an unrolled i x j loop
+(2L x 2L = 2304 FMAs traced) but a BANDED accumulation — for each of the
+2L bytes of `a`, one (2L, T)-shaped FMA adds a_i * b_bytes into the
+column window [i, i + 2L) of a (4L, T) f32 scratch:
+
+    for i in 0..2L-1:  t[i : i+2L, :] += a_byte[i] * b_bytes
+
+f32 accumulation is exact: products <= 255^2, column sums <= 2L terms
+=> < 2^22 < 2^24. The three SOS phases (t = a*b; m = t_lo * (-p^-1) mod R;
+m*p) all use the same band loop — the constant products use Python-float
+byte constants, costing a scalar*tensor FMA per band row. Carries run as
+the same log-depth Kogge-Stone sweep as field_jax._carry_sweep, on VMEM
+values. The algorithm is bit-identical to field_jax.mont_mul (same SOS
+reduction; oracle-tested in tests/test_field_pallas.py).
+
+Select with DPT_FIELD_MUL=pallas (TPU; other platforms fall back to the
+f32 XLA path automatically, and tests exercise the kernel via
+interpret mode).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LIMB_BITS = 16
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+# lanes per grid step: f32 tiling wants multiples of (8, 128); 512 lanes
+# keeps the (4L, T) f32 scratch at 96*512*4 = 196 KB for Fq — far under
+# VMEM — while giving the VPU full rows.
+LANE_TILE = 512
+
+
+def _const_bytes(value, n_bytes):
+    """Python int -> list of n_bytes byte values (little-endian)."""
+    return [(value >> (8 * k)) & 0xFF for k in range(n_bytes)]
+
+
+def _carry_sweep_val(cols, n_limbs):
+    """Kogge-Stone carry propagation on an in-register (K, T) i32 value
+    (entries any u32; see field_jax._carry_sweep for the bound argument).
+    Returns (limbs (K, T) in [0, 2^16), carry_out (T,) i32)."""
+    lo = cols & LIMB_MASK
+    hi = jnp.right_shift(cols, LIMB_BITS)
+    zero_row = jnp.zeros_like(hi[:1])
+    s = lo + jnp.concatenate([zero_row, hi[:-1]], axis=0)
+
+    def shift_down(x, k):
+        return jnp.concatenate([jnp.zeros_like(x[:k]), x[:-k]], axis=0)
+
+    # carry masks as 0/1 i32, not bool: Mosaic cannot concatenate i1
+    # vector registers (shift_down is a concat)
+    gen = (s > LIMB_MASK).astype(jnp.int32)
+    prop = (s == LIMB_MASK).astype(jnp.int32)
+    k = 1
+    while k < n_limbs:
+        gen = gen | (prop & shift_down(gen, k))
+        prop = prop & shift_down(prop, k)
+        k *= 2
+    b_in = shift_down(gen, 1)
+    limbs = (s + b_in) & LIMB_MASK
+    # positive top-row index: x[-1] lowers via dynamic_slice, which the
+    # Mosaic TC pipeline does not implement
+    top = s.shape[0] - 1
+    carry = hi[top] + gen[top]
+    return limbs, carry
+
+
+def _to_bytes_f32(limbs):
+    """(L, T) i32 16-bit limbs -> (2L, T) f32 byte rows (little-endian:
+    row 2k = limb k low byte, row 2k+1 = high byte)."""
+    L, T = limbs.shape
+    ev = (limbs & 0xFF).astype(jnp.float32)
+    od = jnp.right_shift(limbs, 8).astype(jnp.float32)
+    # interleave via stack + reshape on the major axis
+    return jnp.stack([ev, od], axis=1).reshape(2 * L, T)
+
+
+def _band_mul(t_ref, a_bytes, b_bytes):
+    """Banded accumulation: out[k] = sum_{i+j=k} a_i * b_j, computed as
+    2L shifted full-width (2L, T) FMAs accumulated IN PLACE into the
+    (4L, T) f32 VMEM scratch t_ref (a concat- or .at[]-based functional
+    accumulation copies the whole column buffer every iteration — 144
+    buffer copies per product — and .at[].add's scatter lowering is
+    rejected by pallas anyway). Returns the scratch value."""
+    nb, T = a_bytes.shape
+    t_ref[...] = jnp.zeros((2 * nb, T), jnp.float32)
+    for i in range(nb):
+        t_ref[i:i + nb] += a_bytes[i][None, :] * b_bytes
+    return t_ref[...]
+
+
+def _band_mul_const(t_ref, c_bytes, b_bytes):
+    """Same in-place band accumulation with a compile-time constant
+    multiplicand: out[k] = sum_{i+j=k} c_i * b_j, c_i Python scalars."""
+    nb, T = b_bytes.shape
+    t_ref[...] = jnp.zeros((2 * nb, T), jnp.float32)
+    for i, c in enumerate(c_bytes):
+        if c == 0:
+            continue
+        t_ref[i:i + nb] += np.float32(c) * b_bytes
+    return t_ref[...]
+
+
+def _cols_to_limbs(cols_f32):
+    """(2K, T) f32 byte columns -> (K, T) i32 combined limb columns
+    (ev + od*256, any u32 — fed to the carry sweep)."""
+    twoK, T = cols_f32.shape
+    v = cols_f32.reshape(twoK // 2, 2, T)
+    ev = v[:, 0].astype(jnp.int32)
+    od = v[:, 1].astype(jnp.int32)
+    return ev + jnp.left_shift(od, 8)
+
+
+def _mont_mul_kernel(a_ref, b_ref, o_ref, t_ref, *, n_limbs, mod_limbs,
+                     ninv_bytes, mod_bytes, negmod_limbs):
+    """One (n_limbs, LANE_TILE) block: full Montgomery SOS product.
+
+    Mirrors field_jax.mont_mul phase for phase; all intermediates live in
+    registers/VMEM (t_ref: one reused (4L, T) f32 column scratch)."""
+    L = n_limbs
+    a = a_ref[...].astype(jnp.int32)
+    b = b_ref[...].astype(jnp.int32)
+
+    a_by = _to_bytes_f32(a)            # (2L, T)
+    b_by = _to_bytes_f32(b)
+
+    # t = a * b: 4L byte columns -> 2L limb columns, carry the low half
+    t_cols = _band_mul(t_ref, a_by, b_by)
+    t_limbs = _cols_to_limbs(t_cols)   # (2L, T) i32
+    t_lo, c_t = _carry_sweep_val(t_limbs[:L], L)
+
+    # m = t_lo * (-p^-1) mod R (constant product, low half kept)
+    tlo_by = _to_bytes_f32(t_lo)
+    m_cols = _band_mul_const(t_ref, ninv_bytes, tlo_by)[:2 * L]
+    m, _ = _carry_sweep_val(_cols_to_limbs(m_cols), L)
+
+    # m * p (constant product, full width)
+    m_by = _to_bytes_f32(m)
+    mp_cols = _band_mul_const(t_ref, mod_bytes, m_by)
+    mp_limbs = _cols_to_limbs(mp_cols)  # (2L, T)
+
+    # low half of t + m*p is 0 mod R; only its carry-out survives
+    _, c_low = _carry_sweep_val(t_lo + mp_limbs[:L], L)
+
+    # high half: (t + m*p) / R, then one conditional subtract of p
+    hi = t_limbs[L:] + mp_limbs[L:]
+    hi = jnp.concatenate([hi[:1] + (c_t + c_low)[None], hi[1:]], axis=0)
+    # 2^(16L) - p as a (L, 1) column built from inlined scalar constants
+    # (pallas kernels cannot capture array constants)
+    negp = jnp.concatenate(
+        [jnp.full((1, 1), int(v), jnp.int32) for v in negmod_limbs], axis=0)
+    r1, c1 = _carry_sweep_val(hi, L)
+    r2, c2 = _carry_sweep_val(hi + negp, L)
+    take2 = (c2 != 0)[None, :]
+    o_ref[...] = jnp.where(take2, r2, r1).astype(jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _mont_mul_flat(spec_key, interpret, a, b):
+    """(L, N) x (L, N) -> (L, N), N a multiple of LANE_TILE."""
+    from .field_jax import FR, FQ
+
+    spec = FR if spec_key == "fr" else FQ
+    L = spec.n_limbs
+    kernel = functools.partial(
+        _mont_mul_kernel, n_limbs=L,
+        mod_limbs=tuple(int(x) for x in spec.mod_limbs),
+        ninv_bytes=tuple(_const_bytes(int_from_limbs(spec.ninv_limbs), 2 * L)),
+        mod_bytes=tuple(_const_bytes(int_from_limbs(spec.mod_limbs), 2 * L)),
+        negmod_limbs=tuple(int(x) for x in spec.negmod_limbs),
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = a.shape[1]
+    grid = n // LANE_TILE
+    scratch = [pltpu.VMEM((4 * L, LANE_TILE), jnp.float32)]
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((L, n), jnp.uint32),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
+                  pl.BlockSpec((L, LANE_TILE), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(a, b)
+
+
+def int_from_limbs(limbs):
+    v = 0
+    for i, x in enumerate(limbs):
+        v |= int(x) << (LIMB_BITS * i)
+    return v
+
+
+def mont_mul(spec, a, b):
+    """Drop-in replacement for field_jax.mont_mul (same semantics):
+    broadcasts b against a, flattens batch dims to lanes, pads to the
+    lane tile, dispatches the fused kernel."""
+    interpret = jax.default_backend() != "tpu"
+    L = spec.n_limbs
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape)
+    b = jnp.broadcast_to(b, shape)
+    lanes = 1
+    for d in shape[1:]:
+        lanes *= d
+    af = a.reshape(L, lanes)
+    bf = b.reshape(L, lanes)
+    pad = (-lanes) % LANE_TILE
+    if pad:
+        af = jnp.pad(af, ((0, 0), (0, pad)))
+        bf = jnp.pad(bf, ((0, 0), (0, pad)))
+    out = _mont_mul_flat(spec.name.lower(), interpret, af, bf)
+    if pad:
+        out = out[:, :lanes]
+    return out.reshape(shape)

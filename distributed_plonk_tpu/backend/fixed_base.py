@@ -107,14 +107,32 @@ class FixedBaseContext:
     """Device-resident windowed table for one base point; reusable across
     batches (the table for G1 is built once per process)."""
 
+    # lanes per device call: one mont_mul's f32 byte-column transient is
+    # ~18 KB/lane (measured: a 2^18-lane call allocates 24 GB and OOMs a
+    # 16 GB v5e), so the batch walk is chunked. 2^15 lanes ≈ 3 GB peak.
+    _CHUNK = int(__import__("os").environ.get("DPT_FIXED_BASE_CHUNK",
+                                              str(1 << 15)))
+
     def __init__(self, base_affine):
         self.table = _table_to_device(_host_window_table(base_affine))
         self._fn = jax.jit(_batch_mul_kernel)
 
     def batch_mul(self, scalars):
         """[s_i]base for host int scalars -> ((24, N),)*3 device Jacobian."""
-        digits = digits_of_scalars(scalars, len(scalars), WINDOW_BITS)
-        return self._fn(*self.table, digits)
+        n = len(scalars)
+        if n <= self._CHUNK:  # common small case: one compile at its own shape
+            digits = digits_of_scalars(scalars, n, WINDOW_BITS)
+            return self._fn(*self.table, digits)
+        # multi-chunk: zero-pad the tail to _CHUNK so exactly ONE kernel
+        # shape compiles regardless of n ([0]G rows are sliced off below)
+        padded = list(scalars) + [0] * ((-n) % self._CHUNK)
+        parts = []
+        for i0 in range(0, len(padded), self._CHUNK):
+            digits = digits_of_scalars(padded[i0:i0 + self._CHUNK],
+                                       self._CHUNK, WINDOW_BITS)
+            parts.append(self._fn(*self.table, digits))
+        return tuple(jnp.concatenate([p[i] for p in parts], axis=1)[:, :n]
+                     for i in range(3))
 
 
 _G1_CTX = None

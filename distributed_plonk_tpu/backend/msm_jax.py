@@ -32,6 +32,7 @@ and the number of reduction phases — and every memory access is regular.
 """
 
 import os
+import threading
 import time
 from functools import partial
 
@@ -102,96 +103,112 @@ def _group_size_batch(n, batch, c, signed=False):
     return g
 
 
-def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
-    """One window's private-group bucket accumulation (unsigned digits,
-    small-window path): COMPLETE projective mixed adds, like the signed
-    scan — the 2-multiplier-instance graph also compiles far faster than
-    the old 7-instance Jacobian add, which is what the multichip dry-run's
-    compile budget rides on.
-
-    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; digits: (n,) uint32
-    < n_buckets. Returns ((24, group, n_buckets),)*3 PROJECTIVE planes
-    with group-g bucket b = sum of g's points whose digit == b (bucket 0
-    included but ignored downstream).
-    """
+def _scan_layout(ax, ay, group):
+    """(24, n) points -> (steps, 24, group) scan inputs."""
     n = ax.shape[1]
     steps = n // group
-    garange = jnp.arange(group)
 
-    def to_scan(a):  # (24, n) -> (steps, 24, group)
+    def to_scan(a):
         return a.reshape(FQ_LIMBS, group, steps).transpose(2, 0, 1)
 
-    def to_scan1(a):  # (n,) -> (steps, group)
-        return a.reshape(group, steps).T
+    return to_scan(ax), to_scan(ay)
+
+
+def _to_scan_m(a, group):
+    """(M, n) per-lane rows -> (steps, group, M) scan inputs."""
+    M, n = a.shape
+    return a.reshape(M, group, n // group).transpose(2, 1, 0)
+
+
+def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
+    """Unsigned COMBINED-LANE bucket accumulation (small-window path).
+
+    All M digit lanes (M = batch x windows) share the point stream: one
+    gather + one scatter + ONE wide complete projective mixed add per
+    scan step covers every lane — the former per-window vmap issued M
+    separate gather/scatter/add op groups per step, which (a) kept each
+    mont_mul below the Pallas kernel's profitable width and (b) paid the
+    per-op dispatch fixed cost M times (round-4 chip measurement:
+    scripts/msm_ab.py).
+
+    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; digits: (M, n)
+    uint32 < n_buckets. Returns ((24, group, M, n_buckets),)*3 PROJECTIVE
+    planes with bucket b of (group g, lane m) = sum of g's points whose
+    lane-m digit == b (bucket 0 included but ignored downstream).
+    """
+    M = digits.shape[0]
+    sx_all, sy_all = _scan_layout(ax, ay, group)
+    xs = (sx_all, sy_all, _to_scan_m(ainf[None, :] | jnp.zeros_like(digits, bool),
+                                     group),
+          _to_scan_m(digits, group))
 
     # varying-zero: under shard_map the scan carry must inherit the inputs'
     # varying-manual-axes tag; adding a data-derived 0 does exactly that
     # (and constant-folds away otherwise)
     vz = ax.ravel()[0] & 0
-    bx, by, bz = (b + vz for b in CJ.proj_inf((group, n_buckets)))
-
-    xs = (to_scan(ax), to_scan(ay), to_scan1(ainf),
-          to_scan1(digits))
+    bx, by, bz = (b + vz for b in CJ.proj_inf((group, M, n_buckets)))
 
     def step(carry, x):
-        bx, by, bz = carry
-        sx, sy, si, dg = x
-        cur = (bx[:, garange, dg], by[:, garange, dg], bz[:, garange, dg])
-        nx, ny, nz = CJ.proj_add_mixed(cur, (sx, sy), si)
-        return (bx.at[:, garange, dg].set(nx),
-                by.at[:, garange, dg].set(ny),
-                bz.at[:, garange, dg].set(nz)), None
+        bx, by, bz = carry            # (24, G, M, B)
+        sx, sy, si, dg = x            # sx/sy (24, G); si/dg (G, M)
+        dg4 = dg[None, :, :, None]
+        dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
+        cur = tuple(jnp.take_along_axis(b, dg4b, axis=3)[..., 0]
+                    for b in (bx, by, bz))
+        sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
+        syb = jnp.broadcast_to(sy[:, :, None], cur[0].shape)
+        nx, ny, nz = CJ.proj_add_mixed(cur, (sxb, syb), si)
+        new = tuple(jnp.put_along_axis(b, dg4b, v[..., None], axis=3,
+                                       inplace=False)
+                    for b, v in zip((bx, by, bz), (nx, ny, nz)))
+        return new, None
 
     (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
     return bx, by, bz
 
 
 def _bucket_scan_signed(ax, ay, ainf, packed, group):
-    """One window's SIGNED-digit bucket accumulation with COMPLETE
-    projective mixed adds — the c=8 hot path: half the buckets of the
-    unsigned scan (128 columns, bucket i holds points whose |digit| ==
-    i+1; the sign is applied to the point's y on the fly), and the
-    accumulator add is RCB15's complete formula (11 muls in 2 stacked-lane
-    instances, NO doubling fallback and NO edge selects — branch-free by
-    construction, the vector-machine-native choice; ark-ec's Pippenger
-    gets the same effect from CPU-side branches, reference
-    src/worker.rs:122).
+    """SIGNED-digit COMBINED-LANE bucket accumulation — the c=8 hot path:
+    half the buckets of the unsigned scan (128 columns, bucket i holds
+    points whose |digit| == i+1; the sign is applied to the point's y on
+    the fly), the accumulator add is RCB15's complete formula (11 muls in
+    2 stacked-lane instances, no doubling fallback, no edge selects), and
+    every scan step is ONE wide gather/add/scatter across all M lanes
+    (see _bucket_scan for why).
 
-    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (n,) uint32
-    = digit + 128 with digit in [-128, 127]. Returns ((24, group, 128),)*3
-    PROJECTIVE bucket planes.
+    ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (M, n)
+    uint32 = digit + 128 with digit in [-128, 127]. Returns
+    ((24, group, M, 128),)*3 PROJECTIVE bucket planes.
     """
-    n = ax.shape[1]
-    steps = n // group
-    garange = jnp.arange(group)
-
-    def to_scan(a):  # (24, n) -> (steps, 24, group)
-        return a.reshape(FQ_LIMBS, group, steps).transpose(2, 0, 1)
-
-    def to_scan1(a):  # (n,) -> (steps, group)
-        return a.reshape(group, steps).T
-
+    M = packed.shape[0]
     off = packed.astype(jnp.int32) - 128
     neg = off < 0
     mag = jnp.abs(off)
-    skip = (mag == 0) | ainf
+    skip = (mag == 0) | ainf[None, :]
     idx = jnp.maximum(mag, 1).astype(jnp.uint32) - 1  # 0..127
 
-    xs = (to_scan(ax), to_scan(ay), to_scan1(skip), to_scan1(neg),
-          to_scan1(idx))
+    sx_all, sy_all = _scan_layout(ax, ay, group)
+    xs = (sx_all, sy_all, _to_scan_m(skip, group), _to_scan_m(neg, group),
+          _to_scan_m(idx, group))
 
     vz = ax.ravel()[0] & 0  # varying-zero, see _bucket_scan
-    bx, by, bz = (b + vz for b in CJ.proj_inf((group, 128)))
+    bx, by, bz = (b + vz for b in CJ.proj_inf((group, M, 128)))
 
     def step(carry, x):
-        bx, by, bz = carry
-        sx, sy, sk, ng, dg = x
-        cur = (bx[:, garange, dg], by[:, garange, dg], bz[:, garange, dg])
-        qy = FJ.select(ng, FJ.neg(CJ.FQ, sy), sy)
-        nx, ny, nz = CJ.proj_add_mixed(cur, (sx, qy), sk)
-        return (bx.at[:, garange, dg].set(nx),
-                by.at[:, garange, dg].set(ny),
-                bz.at[:, garange, dg].set(nz)), None
+        bx, by, bz = carry            # (24, G, M, 128)
+        sx, sy, sk, ng, dg = x        # sx/sy (24, G); sk/ng/dg (G, M)
+        dg4 = dg[None, :, :, None]
+        dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
+        cur = tuple(jnp.take_along_axis(b, dg4b, axis=3)[..., 0]
+                    for b in (bx, by, bz))
+        nsy = FJ.neg(CJ.FQ, sy)       # negate once per step, select per lane
+        qy = jnp.where(ng[None], nsy[:, :, None], sy[:, :, None])
+        sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
+        nx, ny, nz = CJ.proj_add_mixed(cur, (sxb, qy), sk)
+        new = tuple(jnp.put_along_axis(b, dg4b, v[..., None], axis=3,
+                                       inplace=False)
+                    for b, v in zip((bx, by, bz), (nx, ny, nz)))
+        return new, None
 
     (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
     return bx, by, bz
@@ -303,9 +320,8 @@ def bucket_planes_batch(ax, ay, ainf, digits, group):
     B, W, n = digits.shape
     buckets = 1 << (SCALAR_BITS // W)
     flat = digits.reshape(B * W, n)
-    wb = jax.vmap(partial(_bucket_scan, group=group, n_buckets=buckets),
-                  in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
-    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)  # (G, 24, B*W, buckets)
+    wb = _bucket_scan(ax, ay, ainf, flat, group, buckets)
+    planes = tuple(x.transpose(1, 0, 2, 3) for x in wb)  # (G, 24, B*W, buckets)
     return fold_planes(*planes)
 
 
@@ -314,9 +330,8 @@ def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
     inf mask (nc,) + packed digits (B, W, nc) -> ((24, B*W, 2^(c-1)),)*3."""
     B, W, n = packed.shape
     flat = packed.reshape(B * W, n)
-    wb = jax.vmap(partial(_bucket_scan_signed, group=group),
-                  in_axes=(None, None, None, 0))(ax, ay, ainf, flat)
-    planes = tuple(x.transpose(2, 1, 0, 3) for x in wb)
+    wb = _bucket_scan_signed(ax, ay, ainf, flat, group)
+    planes = tuple(x.transpose(1, 0, 2, 3) for x in wb)
     return fold_planes(*planes)
 
 
@@ -466,7 +481,11 @@ class MsmContext:
             # once with a batched inversion (one scalar host round-trip)
             self.point = CJ.batch_to_affine(point)
         else:
-            self.point = points_to_device(bases, pad)
+            # place once at context build: leaving host numpy here would
+            # re-upload the whole sliced key on every _exec_chunked call
+            self.point = tuple(jax.device_put(p)
+                               for p in points_to_device(bases, pad))
+        self._platform = next(iter(self.point[0].devices())).platform
         if self.signed:
             self._digits_batch_fn = jax.jit(
                 partial(signed_digits_from_mont, padded_n=self.padded_n))
@@ -508,16 +527,23 @@ class MsmContext:
         return self._finish_fns[batch]
 
     # adds/s measured from the first fenced chunk call; class-level so every
-    # context on the process shares the calibration
-    _measured_adds_per_s = None
+    # context on the process shares the calibration. Keyed by
+    # (platform, signed, c_batch): a CPU-mesh context must not size chunks
+    # from a TPU rate (or a signed rate from an unsigned shape), and the
+    # write is lock-guarded because fleet workers run MSMs from multiple
+    # connection threads.
+    _measured_adds_per_s = {}
+    _calib_lock = threading.Lock()
+
+    def _calib_key(self):
+        return (self._platform, self.signed, self.c_batch)
 
     def _chunk_lanes(self, B, W):
         """Current per-call point budget (1024-aligned)."""
         budget = self._CALL_ADDS
-        if MsmContext._measured_adds_per_s is not None:
-            budget = min(self._CALL_ADDS_MAX,
-                         int(MsmContext._measured_adds_per_s
-                             * self._CALL_TARGET_S))
+        rate = MsmContext._measured_adds_per_s.get(self._calib_key())
+        if rate is not None:
+            budget = min(self._CALL_ADDS_MAX, int(rate * self._CALL_TARGET_S))
         return max(1024, (budget // (B * W)) & ~1023)
 
     def _exec_chunked(self, digits):
@@ -537,7 +563,8 @@ class MsmContext:
             # wall-clock is dominated by XLA compilation and would wildly
             # under-read the device rate
             warm = self._chunk_calls.get((nc, g), 0) > 0
-            calibrate = (MsmContext._measured_adds_per_s is None
+            calibrate = (self._calib_key() not in
+                         MsmContext._measured_adds_per_s
                          and nc >= 8192 and warm)
             if calibrate:
                 if acc is not None:  # drain queued async work first, or
@@ -551,7 +578,9 @@ class MsmContext:
                 # optimistic rate bounded by _CALL_ADDS_MAX) so the fence
                 # never re-runs on later chunks
                 dt = max(time.perf_counter() - t0, 0.02)
-                MsmContext._measured_adds_per_s = B * W * nc / dt
+                with MsmContext._calib_lock:
+                    MsmContext._measured_adds_per_s.setdefault(
+                        self._calib_key(), B * W * nc / dt)
             self._chunk_calls[(nc, g)] = self._chunk_calls.get((nc, g), 0) + 1
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
             i0 += nc

@@ -109,7 +109,8 @@ def step_msm(log_n, reps=1):
     dt = (time.perf_counter() - t0) / reps
     return {"kernel": f"msm_2p{log_n}", "compile_plus_first_s": round(compile_s, 1),
             "s": round(dt, 3), "points_per_s": round(n / dt),
-            "adds_per_s_calibrated": MsmContext._measured_adds_per_s}
+            "adds_per_s_calibrated": {
+                str(k): v for k, v in MsmContext._measured_adds_per_s.items()}}
 
 
 STEPS = [

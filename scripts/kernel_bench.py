@@ -78,7 +78,8 @@ def bench_msm(log_n, reps=2):
     dt = (time.perf_counter() - t0) / reps
     return {"kernel": f"msm_2p{log_n}", "s": round(dt, 3),
             "points_per_s": round(n / dt),
-            "adds_per_s_calibrated": MsmContext._measured_adds_per_s}
+            "adds_per_s_calibrated": {
+                str(k): v for k, v in MsmContext._measured_adds_per_s.items()}}
 
 
 def bench_ntt(log_n, reps=3):
@@ -101,8 +102,8 @@ def bench_ntt(log_n, reps=3):
 
 def main():
     what = sys.argv[1] if len(sys.argv) > 1 else "all"
-    mode = os.environ.get("DPT_FIELD_MUL", "f32")
-    out = {"mul_path": mode}
+    from distributed_plonk_tpu.backend import field_jax as FJ
+    out = {"mul_path": FJ._MUL_MODE}  # the resolved mode, not a guess
     import jax
     out["platform"] = jax.devices()[0].platform
     if what in ("fr", "all"):

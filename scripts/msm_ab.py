@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""A/B microbenchmark: current per-window vmapped bucket scan vs a
+combined-window single-scatter variant. Run on the chip to find where the
+~48 ms/step goes (one-off diagnostic; findings land in BASELINE.md)."""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_plonk_tpu.constants import FQ_LIMBS
+from distributed_plonk_tpu.backend import curve_jax as CJ
+from distributed_plonk_tpu.backend import field_jax as FJ
+from distributed_plonk_tpu.backend import msm_jax as M
+
+
+def sync(x):
+    np.asarray(x[0][:1, :1] if isinstance(x, tuple) else x[:1, :1])
+
+
+def bench(fn, args, reps=2, tag=""):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    sync(out)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    sync(out)
+    dt = (time.perf_counter() - t0) / reps
+    return {"tag": tag, "compile_s": round(compile_s, 1), "s": round(dt, 3)}
+
+
+def scan_multi(ax, ay, ainf, packed, group):
+    """Combined-window signed bucket scan: ONE gather + ONE scatter per
+    step covering all M = B*W digit lanes; points broadcast across M."""
+    M, n = packed.shape
+    steps = n // group
+    G = group
+
+    def to_scan(a):  # (24, n) -> (steps, 24, G)
+        return a.reshape(FQ_LIMBS, G, steps).transpose(2, 0, 1)
+
+    def to_scan_m(a):  # (M, n) -> (steps, G, M)
+        return a.reshape(M, G, steps).transpose(2, 1, 0)
+
+    off = packed.astype(jnp.int32) - 128
+    neg = off < 0
+    mag = jnp.abs(off)
+    skip = (mag == 0) | ainf[None, :]
+    idx = jnp.maximum(mag, 1).astype(jnp.uint32) - 1  # 0..127
+
+    xs = (to_scan(ax), to_scan(ay), to_scan_m(skip), to_scan_m(neg),
+          to_scan_m(idx))
+
+    vz = ax.ravel()[0] & 0
+    bx, by, bz = (b + vz for b in CJ.proj_inf((G, M, 128)))
+
+    def step(carry, x):
+        bx, by, bz = carry            # (24, G, M, 128)
+        sx, sy, sk, ng, dg = x        # sx (24, G); sk/ng/dg (G, M)
+        dg4 = dg[None, :, :, None]    # (1, G, M, 1)
+        cur = tuple(jnp.take_along_axis(b, dg4, axis=3)[..., 0]
+                    for b in (bx, by, bz))  # (24, G, M)
+        nsy = FJ.neg(CJ.FQ, sy)
+        qy = jnp.where(ng[None], nsy[:, :, None], sy[:, :, None])
+        sxb = jnp.broadcast_to(sx[:, :, None], qy.shape)
+        nx, ny, nz = CJ.proj_add_mixed(cur, (sxb, qy), sk)
+        dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
+        new = (jnp.put_along_axis(b, dg4b, v[..., None], axis=3,
+                                  inplace=False)
+               for b, v in zip((bx, by, bz), (nx, ny, nz)))
+        return tuple(new), None
+
+    (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
+    return bx, by, bz
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 1 << 17
+    B, W = 1, 32
+    group = 256
+
+    ax = jnp.asarray(rng.integers(0, 1 << 16, (FQ_LIMBS, n), dtype=np.uint32))
+    ay = jnp.asarray(rng.integers(0, 1 << 16, (FQ_LIMBS, n), dtype=np.uint32))
+    ainf = jnp.zeros((n,), bool)
+    packed = jnp.asarray(rng.integers(0, 256, (B * W, n), dtype=np.uint32))
+
+    out = {"n_log2": 17, "B": B, "W": W, "group": group,
+           "platform": jax.devices()[0].platform}
+
+    # baseline: current vmapped per-window pipeline
+    cur = jax.jit(partial(M.bucket_planes_batch_signed, group=group))
+    out["current"] = bench(cur, (ax, ay, ainf,
+                                 packed.reshape(B, W, n)), tag="vmap_per_window")
+
+    # combined-window single-scatter scan (planes only, no fold — fold is
+    # cheap; comparable because current includes fold over G which we add)
+    def multi(ax, ay, ainf, packed):
+        bx, by, bz = scan_multi(ax, ay, ainf, packed, group)
+        planes = tuple(x.transpose(1, 0, 2, 3) for x in (bx, by, bz))
+        return M.fold_planes(*planes)
+
+    mj = jax.jit(multi)
+    out["multi"] = bench(mj, (ax, ay, ainf, packed), tag="combined_window")
+
+    # add-only ceiling: same lane count, no gather/scatter at all
+    def add_only(ax, ay, ainf):
+        sx = ax[:, :group * W].reshape(FQ_LIMBS, group, W)
+        sy = ay[:, :group * W].reshape(FQ_LIMBS, group, W)
+        sk = ainf[:group * W].reshape(group, W)
+        vz = ax.ravel()[0] & 0
+        acc = tuple(b + vz for b in CJ.proj_inf((group, W)))
+
+        def step(carry, _):
+            return CJ.proj_add_mixed(carry, (sx, sy), sk), None
+
+        steps = n // group
+        acc, _ = lax.scan(step, acc, None, length=steps)
+        return acc
+
+    aj = jax.jit(add_only)
+    out["add_only"] = bench(aj, (ax, ay, ainf), tag="add_only_ceiling")
+
+    steps = n // group
+    for k in ("current", "multi", "add_only"):
+        out[k]["ms_per_step"] = round(out[k]["s"] / steps * 1e3, 3)
+        out[k]["adds_per_s"] = round(B * W * n / out[k]["s"])
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
