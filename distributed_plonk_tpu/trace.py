@@ -16,8 +16,51 @@ Usage:
 """
 
 import json
+import os
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
+
+# DPT_JAX_TRACE=1: every Tracer span additionally opens a
+# jax.profiler.TraceAnnotation, so spans show up on the device timeline of
+# a jax.profiler capture (the SURVEY §5 device-trace replacement for the
+# reference's wall-clock printouts). Off by default: annotation setup is
+# not free on the hot path and tooling to view traces may be absent.
+_JAX_TRACE = bool(os.environ.get("DPT_JAX_TRACE"))
+
+
+def _jax_annotation(path):
+    if not _JAX_TRACE:
+        return nullcontext()
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(path)
+    except Exception:  # pragma: no cover - profiler backend absent
+        return nullcontext()
+
+
+@contextmanager
+def profile_to(log_dir):
+    """Capture a jax.profiler device trace for the enclosed block into
+    `log_dir` (viewable with tensorboard / xprof). Pairs with
+    DPT_JAX_TRACE=1 so Tracer spans appear as annotations on the device
+    timeline. No-ops (with a note on stderr) when tracing is unsupported
+    on the platform."""
+    import sys
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - tunneled platform quirks
+        print(f"[trace] jax profiler unavailable: {e!r}", file=sys.stderr)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                print(f"[trace] stop_trace failed: {e!r}", file=sys.stderr)
 
 
 class Tracer:
@@ -31,7 +74,8 @@ class Tracer:
         self._stack.append(name)
         t0 = time.perf_counter()
         try:
-            yield
+            with _jax_annotation(path):
+                yield
         finally:
             dur = time.perf_counter() - t0
             self._stack.pop()
