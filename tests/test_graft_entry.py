@@ -23,9 +23,11 @@ import pytest
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
-# generous vs the ~2 min measured cold; catches a regression back toward
-# the round-1 ~9 min state while tolerating shared-host noise
-BUDGET_S = 480
+# matches the driver-facing _DRYRUN_TIMEOUT_S contract: since round 4 the
+# dryrun runs a FULL tiny mesh prove (cold-compiles the SPMD prover
+# programs, ~15-20 min cold on a shared 8-core host; minutes warm via the
+# persistent compile cache)
+BUDGET_S = 2400
 
 # TEST-NET-1 address (RFC 5737): guaranteed non-routable, so a connect
 # attempt hangs/black-holes — the observed behavior of the dead relay
@@ -89,7 +91,11 @@ def test_bench_emits_valid_json_with_dead_relay():
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in out, out
     assert out.get("degraded") is True
-    assert isinstance(out["value"], (int, float))
+    # degraded mode must NOT present a stale recorded number as this
+    # run's value (round-3 advisor finding): value is null and the
+    # recorded chip measurement moves to its own clearly-marked key
+    assert out["value"] is None
+    assert isinstance(out["recorded_prove_2p13_s"], (int, float))
 
 
 def test_entry_compiles_and_runs():
