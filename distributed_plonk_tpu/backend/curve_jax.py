@@ -10,6 +10,8 @@ the doubling, and infinity fallbacks unconditionally and `where`-selects —
 the TPU-idiomatic shape for data-dependent curve edge cases.
 """
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -17,6 +19,23 @@ from ..constants import FQ_MONT_R, FQ_LIMBS, Q_MOD
 from . import field_jax as FJ
 from .field_jax import FQ
 from .limbs import int_to_limbs, ints_to_limbs, limbs_to_ints
+
+# DPT_CURVE_ADD selects the fused whole-formula Pallas add kernel
+# (curve_pallas.py). Default is xla (OFF): measured round 4 on a v5e
+# (scripts/add_bench.py, 8192 lanes), the fused kernel ties the staged
+# XLA path exactly (131 ms / 32 steps both — the staged path's muls
+# already ride the fused Pallas multiplier, and at MSM widths XLA's
+# per-op overhead amortizes) while costing ~194 s of Mosaic compile per
+# distinct shape. auto/pallas opt back in under the multiplier's gate.
+_ADD_MODE = os.environ.get("DPT_CURVE_ADD", "xla")
+
+
+def _use_fused_add(*shapes):
+    if _ADD_MODE == "pallas":        # force, regardless of the mul gate
+        return True
+    if _ADD_MODE != "auto":          # default "xla": fused add off
+        return False
+    return FJ._use_pallas(jnp.broadcast_shapes(*shapes))
 
 _MONT_ONE = int_to_limbs(FQ_MONT_R, FQ_LIMBS)  # 1 in Montgomery form
 _MONT_R_INV = pow(FQ_MONT_R, Q_MOD - 2, Q_MOD)
@@ -160,7 +179,13 @@ def proj_inf(batch_shape=()):
 
 def proj_add(p, q):
     """Complete projective P + Q (RCB15 algorithm 7, a=0): 12 full muls in
-    2 stacked-lane instances + 2 cheap b3 multiplies. No special cases."""
+    2 stacked-lane instances + 2 cheap b3 multiplies. No special cases.
+
+    Wide shapes on TPU run the whole formula as ONE fused Pallas program
+    (curve_pallas.py) — same op sequence, intermediates in VMEM."""
+    if _use_fused_add(*[c.shape for c in (*p, *q)]):
+        from . import curve_pallas as CP
+        return CP.proj_add(p, q)
     x1, y1, z1 = p
     x2, y2, z2 = q
     t0, t1, t2, m3, m4, m5 = _mul_lanes([
@@ -189,7 +214,14 @@ def proj_add(p, q):
 def proj_add_mixed(p, q_affine, q_inf):
     """Complete projective P + affine Q (RCB15 algorithm 8, a=0): 11 full
     muls in 2 stacked-lane instances. Complete in P; the only mask is for
-    Q flagged infinite (padding / zero digit), which returns P."""
+    Q flagged infinite (padding / zero digit), which returns P.
+
+    Wide shapes on TPU run the whole formula as ONE fused Pallas program
+    (curve_pallas.py; the q_inf select stays here in XLA, where it fuses)."""
+    if _use_fused_add(*[c.shape for c in (*p, *q_affine)]):
+        from . import curve_pallas as CP
+        res = CP.proj_add_mixed(p, q_affine)
+        return pt_select(q_inf, p, res)
     x1, y1, z1 = p
     x2, y2 = q_affine
     t0, t1, m3, t4a, y3a = _mul_lanes([

@@ -74,10 +74,14 @@ def _carry_sweep_val(cols, n_limbs):
         k *= 2
     b_in = shift_down(gen, 1)
     limbs = (s + b_in) & LIMB_MASK
-    # positive top-row index: x[-1] lowers via dynamic_slice, which the
-    # Mosaic TC pipeline does not implement
-    top = s.shape[0] - 1
-    carry = hi[top] + gen[top]
+    # top-row extraction WITHOUT a row slice: x[-1] lowers via
+    # dynamic_slice (unimplemented in the Mosaic TC pipeline), and a
+    # static x[top] of row 23 gives the result an offset-7 vector layout
+    # that poisons any later lane-concatenate (the fused add's group
+    # stacking). A masked row reduction yields a clean-layout vector.
+    top_mask = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                == s.shape[0] - 1).astype(jnp.int32)
+    carry = jnp.sum((hi + gen) * top_mask, axis=0)
     return limbs, carry
 
 

@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Microbenchmark: bare chain of complete projective mixed adds, fused
+Pallas kernel vs the XLA staged-lane path — the MSM scan-step inner op
+with gather/scatter removed.
+
+Round-4 chip verdict from this tool (BASELINE.md): the bare add chain
+runs at ~2.0M lane-adds/s on BOTH paths (the staged path's muls already
+ride the fused Pallas multiplier, and at these widths XLA per-op
+overhead amortizes; the fused whole-formula kernel ties it exactly while
+costing ~194 s of Mosaic compile per shape). Since the full bucket scan
+ran at only ~0.52M, the MSM bottleneck was the take/put_along_axis
+scatter lowering, NOT the add — see scripts/scatter_ab.py for the 4.4x
+one-hot fix.
+
+Usage: python scripts/add_bench.py [--lanes 8192] [--steps 32] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=8192)
+    ap.add_argument("--steps", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from distributed_plonk_tpu.constants import FQ_LIMBS
+    from distributed_plonk_tpu.backend import curve_jax as CJ
+
+    rng = np.random.default_rng(7)
+
+    def rand_fq(shape):
+        # arbitrary sub-p limb patterns: the add is straight-line, so
+        # timing is data-independent (correctness is oracle-tested in
+        # tests/test_curve_pallas.py)
+        v = rng.integers(0, 1 << 16, size=(FQ_LIMBS,) + shape, dtype=np.uint32)
+        v[-1] &= 0x1FFF
+        return jnp.asarray(v)
+
+    L = args.lanes
+    acc = (rand_fq((L,)), rand_fq((L,)), rand_fq((L,)))
+    qx = jnp.moveaxis(rand_fq((args.steps, L)), 1, 0)  # (steps, 24, L)
+    qy = jnp.moveaxis(rand_fq((args.steps, L)), 1, 0)
+    q_inf = jnp.zeros((args.steps, L), bool)
+
+    def chain(acc, qx, qy, q_inf):
+        def step(a, x):
+            return CJ.proj_add_mixed(a, (x[0], x[1]), x[2]), None
+        out, _ = lax.scan(step, acc, (qx, qy, q_inf))
+        return out
+
+    results = {"lanes": L, "steps": args.steps,
+               "backend": jax.default_backend()}
+    for mode, name in ((None, "fused"), ("xla", "xla")):
+        CJ._ADD_MODE = mode or "auto"
+        fn = jax.jit(chain)
+        t0 = time.perf_counter()
+        out = fn(acc, qx, qy, q_inf)
+        np.asarray(out[0][:1, :1])
+        results[f"{name}_compile_s"] = round(time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            out = fn(acc, qx, qy, q_inf)
+        np.asarray(out[0][:1, :1])
+        dt = (time.perf_counter() - t0) / args.reps
+        results[f"{name}_s"] = round(dt, 4)
+        results[f"{name}_adds_per_s"] = int(L * args.steps / dt)
+        print(f"[add_bench] {name}: {dt*1e3:.1f} ms for {args.steps} steps"
+              f" x {L} lanes = {results[f'{name}_adds_per_s']/1e3:.0f}k adds/s",
+              file=sys.stderr)
+
+    line = json.dumps(results)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    main()
