@@ -76,8 +76,17 @@ def _group_size(n):
     total time tracks total lane-adds almost linearly, so G is kept at
     ~n/1024 — fold work <= 25% of scan work — instead of the old fixed 512
     (which at n=9216 made the fold 14x the scan and a 5-poly commit batch
-    8x slower than G=8)."""
-    g = 512
+    8x slower than G=8).
+
+    DPT_MSM_GROUP_MAX raises the 512 cap: with the onehot plane update
+    (no scatter op) per-ADD plane traffic is G-independent, so wider
+    groups only amortize per-step overhead better — bounded by the fold
+    work and the plane-budget cap in _group_size_batch."""
+    g = int(os.environ.get("DPT_MSM_GROUP_MAX", "512"))
+    if g < 1:
+        g = 512
+    g = 1 << (g.bit_length() - 1)  # round down to a power of two: the
+    # halving search below only terminates on divisors of power-of-two n
     while g > 1 and (n % g != 0 or n // g < 2 or g * 1024 > n):
         g //= 2
     return g
@@ -86,6 +95,50 @@ def _group_size(n):
 # peak bucket-plane footprint allowed for a batched MSM (all three Jacobian
 # coords); beyond this the group width halves, trading scan steps for HBM
 _PLANE_BYTES_BUDGET = int(os.environ.get("DPT_MSM_PLANE_MB", "1536")) << 20
+
+# Bucket-plane update strategy for the accumulation scans (DPT_BUCKET_UPDATE):
+#   put:    take_along_axis / put_along_axis on the bucket axis.
+#   onehot: gather = masked reduction over the bucket axis, update = broadcast
+#           compare + where over the whole plane. No scatter op at all — pure
+#           streaming reads/writes.
+#   auto (default): onehot on TPU, put elsewhere. Measured round 4 on a v5e
+#   (scripts/scatter_ab.py, G=256 M=32 B=128): put 15.6 ms/step (524k
+#   lane-adds/s) vs onehot 3.5 ms/step (2.32M) — TPU scatter lowering, not
+#   the projective add, was the MSM's 4.4x bottleneck. On CPU the scatter is
+#   cheap and onehot's full-plane traffic (x buckets) would swamp the mesh
+#   tests, hence the platform split.
+_BUCKET_UPDATE = os.environ.get("DPT_BUCKET_UPDATE", "auto")
+
+
+def _use_onehot_update():
+    if _BUCKET_UPDATE in ("onehot", "put"):
+        return _BUCKET_UPDATE == "onehot"
+    return jax.default_backend() == "tpu"
+
+
+def _plane_gather(planes, dg):
+    """Current bucket values at per-lane digits dg (G, M) from (24, G, M, B)
+    planes -> ((24, G, M),)*3, plus the reusable update context."""
+    if _use_onehot_update():
+        hit = dg[None, :, :, None] == lax.broadcasted_iota(
+            dg.dtype, (1,) + planes[0].shape[1:], 3)
+        cur = tuple(jnp.sum(jnp.where(hit, b, 0), axis=3, dtype=b.dtype)
+                    for b in planes)
+        return cur, hit
+    dg4 = dg[None, :, :, None]
+    dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
+    cur = tuple(jnp.take_along_axis(b, dg4b, axis=3)[..., 0] for b in planes)
+    return cur, dg4b
+
+
+def _plane_update(planes, vals, ctx):
+    """Write vals (each (24, G, M)) back at the gathered positions."""
+    if _use_onehot_update():
+        return tuple(jnp.where(ctx, v[..., None], b)
+                     for b, v in zip(planes, vals))
+    return tuple(jnp.put_along_axis(b, ctx, v[..., None], axis=3,
+                                    inplace=False)
+                 for b, v in zip(planes, vals))
 
 
 def _group_size_batch(n, batch, c, signed=False):
@@ -149,19 +202,13 @@ def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
     bx, by, bz = (b + vz for b in CJ.proj_inf((group, M, n_buckets)))
 
     def step(carry, x):
-        bx, by, bz = carry            # (24, G, M, B)
+        planes = carry                # (24, G, M, B) x3
         sx, sy, si, dg = x            # sx/sy (24, G); si/dg (G, M)
-        dg4 = dg[None, :, :, None]
-        dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
-        cur = tuple(jnp.take_along_axis(b, dg4b, axis=3)[..., 0]
-                    for b in (bx, by, bz))
+        cur, ctx = _plane_gather(planes, dg)
         sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
         syb = jnp.broadcast_to(sy[:, :, None], cur[0].shape)
-        nx, ny, nz = CJ.proj_add_mixed(cur, (sxb, syb), si)
-        new = tuple(jnp.put_along_axis(b, dg4b, v[..., None], axis=3,
-                                       inplace=False)
-                    for b, v in zip((bx, by, bz), (nx, ny, nz)))
-        return new, None
+        nv = CJ.proj_add_mixed(cur, (sxb, syb), si)
+        return _plane_update(planes, nv, ctx), None
 
     (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
     return bx, by, bz
@@ -195,20 +242,14 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group):
     bx, by, bz = (b + vz for b in CJ.proj_inf((group, M, 128)))
 
     def step(carry, x):
-        bx, by, bz = carry            # (24, G, M, 128)
+        planes = carry                # (24, G, M, 128) x3
         sx, sy, sk, ng, dg = x        # sx/sy (24, G); sk/ng/dg (G, M)
-        dg4 = dg[None, :, :, None]
-        dg4b = jnp.broadcast_to(dg4, (FQ_LIMBS,) + dg4.shape[1:])
-        cur = tuple(jnp.take_along_axis(b, dg4b, axis=3)[..., 0]
-                    for b in (bx, by, bz))
+        cur, ctx = _plane_gather(planes, dg)
         nsy = FJ.neg(CJ.FQ, sy)       # negate once per step, select per lane
         qy = jnp.where(ng[None], nsy[:, :, None], sy[:, :, None])
         sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
-        nx, ny, nz = CJ.proj_add_mixed(cur, (sxb, qy), sk)
-        new = tuple(jnp.put_along_axis(b, dg4b, v[..., None], axis=3,
-                                       inplace=False)
-                    for b, v in zip((bx, by, bz), (nx, ny, nz)))
-        return new, None
+        nv = CJ.proj_add_mixed(cur, (sxb, qy), sk)
+        return _plane_update(planes, nv, ctx), None
 
     (bx, by, bz), _ = lax.scan(step, (bx, by, bz), xs)
     return bx, by, bz

@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""A/B microbenchmark: bucket-plane update strategies for the MSM scan.
+
+Round-4 finding (add_bench.py): the complete add itself runs at ~2M
+lane-adds/s on chip, but the full bucket scan only ~0.5M — the
+take_along_axis gather + put_along_axis scatter on the (24, G, M, B)
+planes costs ~5x the add. Candidates:
+
+  put      — current: take_along_axis / put_along_axis on axis 3
+  onehot   — gather = masked reduction over the bucket axis; update =
+             broadcast compare + where over the whole plane (pure
+             streaming HBM traffic, no scatter lowering at all)
+
+Usage: python scripts/scatter_ab.py [--g 256] [--m 32] [--steps 64]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--g", type=int, default=256)
+    ap.add_argument("--m", type=int, default=32)
+    ap.add_argument("--buckets", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from distributed_plonk_tpu.constants import FQ_LIMBS
+    from distributed_plonk_tpu.backend import curve_jax as CJ
+
+    G, M, B, S = args.g, args.m, args.buckets, args.steps
+    rng = np.random.default_rng(11)
+
+    def rand_fq(shape):
+        v = rng.integers(0, 1 << 16, size=(FQ_LIMBS,) + shape,
+                         dtype=np.uint32)
+        v[-1] &= 0x1FFF
+        return jnp.asarray(v)
+
+    planes = tuple(rand_fq((G, M, B)) for _ in range(3))
+    sx = jnp.moveaxis(rand_fq((S, G)), 1, 0)       # (S, 24, G)
+    sy = jnp.moveaxis(rand_fq((S, G)), 1, 0)
+    dg = jnp.asarray(rng.integers(0, B, size=(S, G, M), dtype=np.uint32))
+    skip = jnp.zeros((S, G, M), bool)
+
+    def step_put(carry, x):
+        bx, by, bz = carry
+        sx, sy, sk, d = x
+        d4 = d[None, :, :, None]
+        d4b = jnp.broadcast_to(d4, (FQ_LIMBS,) + d4.shape[1:])
+        cur = tuple(jnp.take_along_axis(b, d4b, axis=3)[..., 0]
+                    for b in (bx, by, bz))
+        sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
+        syb = jnp.broadcast_to(sy[:, :, None], cur[0].shape)
+        nv = CJ.proj_add_mixed(cur, (sxb, syb), sk)
+        new = tuple(jnp.put_along_axis(b, d4b, v[..., None], axis=3,
+                                       inplace=False)
+                    for b, v in zip((bx, by, bz), nv))
+        return new, None
+
+    bidx = lax.broadcasted_iota(jnp.uint32, (1, G, M, B), 3)
+
+    def step_onehot(carry, x):
+        bx, by, bz = carry
+        sx, sy, sk, d = x
+        hit = d[None, :, :, None] == bidx           # (1, G, M, B)
+        cur = tuple(
+            jnp.sum(jnp.where(hit, b, 0), axis=3, dtype=jnp.uint32)
+            for b in (bx, by, bz))
+        sxb = jnp.broadcast_to(sx[:, :, None], cur[0].shape)
+        syb = jnp.broadcast_to(sy[:, :, None], cur[0].shape)
+        nv = CJ.proj_add_mixed(cur, (sxb, syb), sk)
+        new = tuple(jnp.where(hit, v[..., None], b)
+                    for b, v in zip((bx, by, bz), nv))
+        return new, None
+
+    results = {"g": G, "m": M, "buckets": B, "steps": S,
+               "backend": jax.default_backend()}
+    for name, step in (("put", step_put), ("onehot", step_onehot)):
+        @jax.jit
+        def scan(planes, xs, step=step):
+            return lax.scan(step, planes, xs)[0]
+
+        xs = (sx, sy, skip, dg)
+        t0 = time.perf_counter()
+        out = scan(planes, xs)
+        np.asarray(out[0][:1, :1, :1, :1])
+        results[f"{name}_compile_s"] = round(time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            out = scan(planes, xs)
+        np.asarray(out[0][:1, :1, :1, :1])
+        dt = (time.perf_counter() - t0) / args.reps
+        results[f"{name}_s"] = round(dt, 4)
+        results[f"{name}_ms_per_step"] = round(dt / S * 1e3, 2)
+        results[f"{name}_adds_per_s"] = int(G * M * S / dt)
+        print(f"[scatter_ab] {name}: {dt/S*1e3:.1f} ms/step "
+              f"({results[f'{name}_adds_per_s']/1e3:.0f}k adds/s)",
+              file=sys.stderr)
+
+    line = json.dumps(results)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    main()
