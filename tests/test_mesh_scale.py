@@ -54,11 +54,40 @@ def test_mesh_msm_2p12(mesh8):
     bases = (distinct * (n // 64))[:n]
     scalars = [RNG.randrange(R_MOD) for _ in range(n)]
     ctx = MeshMsmContext(mesh8, bases)
+    assert ctx.signed  # local slice 512 >= 256: the c=8 signed hot path
     t0 = time.time()
     got = ctx.msm(scalars)
     elapsed = time.time() - t0
     assert got == C.g1_msm(bases, scalars)
     assert elapsed < 900, f"mesh 2^12 MSM took {elapsed:.0f}s"
+
+
+@pytest.mark.slow
+def test_mesh_msm_2p16_signed_handles(mesh8):
+    """2^16-point mesh MSM through the PROVER surface: Montgomery poly
+    handles in, signed batched pipeline per shard, on-device digit
+    extraction + plane fold (the round-3 ceiling was 2^12 host-int
+    scalars through the unsigned scan; reference micro-test scale is
+    2^20 over live workers, src/dispatcher.rs:188-196)."""
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.parallel.msm_mesh import MeshMsmContext
+    from distributed_plonk_tpu.backend import prover_jax as PJ
+
+    n = 1 << 16
+    distinct = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD))
+                for _ in range(256)]
+    bases = (distinct * (n // 256))[:n]
+    ctx = MeshMsmContext(mesh8, bases)
+    assert ctx.signed and ctx.c == 8
+    coeff_lists = [[RNG.randrange(R_MOD) for _ in range(n)]
+                   for _ in range(2)]
+    handles = [jnp.asarray(PJ.lift(cs)) for cs in coeff_lists]
+    t0 = time.time()
+    got = ctx.msm_mont_limbs_many(handles)
+    elapsed = time.time() - t0
+    for g, cs in zip(got, coeff_lists):
+        assert g == C.g1_msm(bases, cs)
+    assert elapsed < 1800, f"mesh 2^16 batched MSM took {elapsed:.0f}s"
 
 
 def test_quotient_domain_2p21_memory_plan():
