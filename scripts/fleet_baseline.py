@@ -9,7 +9,7 @@ locally, prove()s through RemoteBackend so every NTT/MSM rides the fleet
 protocol, verifies, and emits one JSON line.
 
 Usage: python scripts/fleet_baseline.py [--workers 4] [--height 32]
-           [--proofs 1] [--out FILE]
+           [--proofs 1] [--worker-timeout S] [--out FILE]
 """
 
 import argparse
@@ -38,7 +38,6 @@ def main():
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--height", type=int, default=32)
     ap.add_argument("--proofs", type=int, default=1)
-    ap.add_argument("--port-base", type=int, default=21000)
     ap.add_argument("--worker-timeout", type=float, default=600,
                     help="seconds to wait for the fleet to come up (4 jax"
                          " imports on one contended core take minutes)")
@@ -47,10 +46,8 @@ def main():
 
     # the dispatcher side must also be CPU-pinned: RemoteBackend runs the
     # round math locally between fleet calls
-    for k in list(os.environ):
-        if k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
-            os.environ.pop(k)
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.clear()
+    os.environ.update(scrubbed_cpu_env())
 
     from distributed_plonk_tpu import kzg
     from distributed_plonk_tpu.prover import prove
@@ -77,18 +74,29 @@ def main():
     print(f"[fleet] host setup+preprocess {res['setup_preprocess_host_s']}s",
           file=sys.stderr)
 
-    base = args.port_base + (os.getpid() % 500) * args.workers
+    def free_port():
+        # bind-0-and-read-back (same trick as tests/test_multihost.py):
+        # beats a pid-derived fixed scheme, which fails only after the
+        # full worker-timeout when a computed port is already bound
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
     cfg_path = os.path.join(REPO, f".fleet_baseline_{os.getpid()}.json")
-    cfg = NetworkConfig([f"127.0.0.1:{base + i}" for i in range(args.workers)])
+    cfg = NetworkConfig(
+        [f"127.0.0.1:{free_port()}" for _ in range(args.workers)])
     cfg.save(cfg_path)
-    logs = [open(os.path.join(REPO, f".fleet_worker_{i}.log"), "w")
-            for i in range(args.workers)]
-    procs = [subprocess.Popen(
-        [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
-         str(i), cfg_path, "--backend", "jax"],
-        cwd=REPO, env=scrubbed_cpu_env(), stdout=log, stderr=log)
-        for i, log in zip(range(args.workers), logs)]
+    logs = []
+    procs = []
     try:
+        for i in range(args.workers):
+            log = open(os.path.join(REPO, f".fleet_worker_{i}.log"), "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
+                 str(i), cfg_path, "--backend", "jax"],
+                cwd=REPO, env=scrubbed_cpu_env(), stdout=log, stderr=log))
         d = None
         deadline = time.time() + args.worker_timeout
         while time.time() < deadline:

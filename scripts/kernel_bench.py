@@ -115,6 +115,10 @@ def main():
     if what in ("msm", "all"):
         out["msm_2p16"] = bench_msm(16)
         out["msm_2p20"] = bench_msm(20, reps=1)
+    if what == "msm24":
+        # BASELINE config #5 (2^24 streaming MSM): the chunked pipeline
+        # streams ~4.6 GB of bases through per-call-budget device launches
+        out["msm_2p24"] = bench_msm(24, reps=1)
     print(json.dumps(out))
 
 

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Execute the v2 workload's quotient-domain-sized NTT on a device mesh.
+
+The reference's v2 run needs a 2^21-point FFT (50 proofs -> 2^18 domain,
+8n quotient domain, /root/reference/src/dispatcher2.rs:1219-1221,246).
+Until round 4 that size existed here only as an analytical memory plan
+(parallel/memory_plan.py); this script actually runs it: forward coset
+FFT then inverse on an N-device mesh (virtual CPU mesh by default, the
+same code path a v5e pod would compile), asserting the round trip is
+bit-exact and the forward output matches the host oracle FFT on a
+random polynomial.
+
+Usage:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/mesh_ntt_scale.py [--log2n 21] [--devices 8] \
+      [--skip-oracle] [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+# Default to the virtual CPU mesh (same recipe as tests/conftest.py: the
+# axon sitecustomize imports jax at interpreter startup, so the env alone
+# is not enough — pin the in-process config too). DPT_MESH_PLATFORM=real
+# skips the forcing for an actual multi-chip pod.
+if os.environ.get("DPT_MESH_PLATFORM", "cpu") == "cpu":
+    for _k in list(os.environ):
+        if _k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
+            os.environ.pop(_k)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log2n", type=int, default=21)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--skip-oracle", action="store_true",
+                    help="round-trip + linearity only (the pure-Python"
+                         " oracle FFT takes ~minutes at 2^21)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from distributed_plonk_tpu.constants import R_MOD
+    from distributed_plonk_tpu.parallel.ntt_mesh import MeshNttPlan, SHARD_AXIS
+    from distributed_plonk_tpu.backend import prover_jax as PJ
+
+    n = 1 << args.log2n
+    devs = jax.devices()[:args.devices]
+    assert len(devs) == args.devices, (
+        f"need {args.devices} devices, have {len(devs)} — set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count")
+    mesh = Mesh(np.array(devs), (SHARD_AXIS,))
+    res = {"log2n": args.log2n, "devices": args.devices,
+           "platform": devs[0].platform}
+
+    rng = random.Random(0x2221)
+    coeffs = [rng.randrange(R_MOD) for _ in range(n)]
+    t0 = time.perf_counter()
+    h = jnp.asarray(PJ.lift(coeffs))
+    res["lift_s"] = round(time.perf_counter() - t0, 2)
+
+    plan = MeshNttPlan(mesh, n)
+    fwd = plan.kernel(inverse=False, coset=True, boundary="mont")
+    inv = plan.kernel(inverse=True, coset=True, boundary="mont")
+
+    t0 = time.perf_counter()
+    ev = fwd(h)
+    ev.block_until_ready()
+    res["fwd_cold_s"] = round(time.perf_counter() - t0, 2)
+    t0 = time.perf_counter()
+    back = inv(ev)
+    back.block_until_ready()
+    res["inv_cold_s"] = round(time.perf_counter() - t0, 2)
+    assert np.array_equal(np.asarray(back), np.asarray(h)), (
+        "coset fft/ifft round trip not bit-exact")
+    res["roundtrip_exact"] = True
+
+    t0 = time.perf_counter()
+    ev2 = fwd(h)
+    ev2.block_until_ready()
+    dt = time.perf_counter() - t0
+    res["fwd_warm_s"] = round(dt, 4)
+    res["elements_per_s"] = round(n / dt)
+
+    if not args.skip_oracle:
+        from distributed_plonk_tpu import poly
+        t0 = time.perf_counter()
+        exp = poly.coset_fft(poly.Domain(n), coeffs)
+        res["oracle_s"] = round(time.perf_counter() - t0, 2)
+        assert PJ.lower(ev) == exp, "mesh coset FFT diverges from host oracle"
+        res["oracle_match"] = True
+
+    line = json.dumps(res)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+if __name__ == "__main__":
+    main()

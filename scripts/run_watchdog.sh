@@ -1,0 +1,43 @@
+#!/bin/bash
+# Stall watchdog for long chip runs: the relay's compile endpoint
+# occasionally never replies (client blocks forever in tcp_recvmsg with
+# no timeout available at our layer). Restart the run when its CPU time
+# freezes; the persistent XLA compile cache makes every attempt ratchet
+# past the shapes already compiled.
+#
+# Usage: run_watchdog.sh <stall_seconds> <max_attempts> <logfile> -- cmd...
+set -u
+if [[ $# -lt 5 || ${4:-} != "--" ]]; then
+  echo "usage: run_watchdog.sh <stall_s> <max_attempts> <logfile> -- cmd..." >&2
+  exit 2
+fi
+STALL=$1; MAX=$2; LOG=$3; shift 4
+
+for attempt in $(seq 1 "$MAX"); do
+  echo "[watchdog] attempt $attempt: $*" >> "$LOG"
+  setsid "$@" >> "$LOG" 2>&1 &
+  PID=$!
+  last_cpu=""
+  last_change=$(date +%s)
+  while kill -0 "$PID" 2>/dev/null; do
+    sleep 30
+    cpu=$(awk '{print $14+$15}' "/proc/$PID/stat" 2>/dev/null || echo "")
+    now=$(date +%s)
+    if [[ -n "$cpu" && "$cpu" != "$last_cpu" ]]; then
+      last_cpu=$cpu
+      last_change=$now
+    elif (( now - last_change > STALL )); then
+      echo "[watchdog] stall: no CPU progress for ${STALL}s, killing $PID" >> "$LOG"
+      kill -9 -- "-$PID" 2>/dev/null || kill -9 "$PID" 2>/dev/null
+      wait "$PID" 2>/dev/null
+      break
+    fi
+  done
+  if wait "$PID" 2>/dev/null; then
+    echo "[watchdog] attempt $attempt succeeded" >> "$LOG"
+    exit 0
+  fi
+  echo "[watchdog] attempt $attempt ended (rc != 0 or killed)" >> "$LOG"
+done
+echo "[watchdog] giving up after $MAX attempts" >> "$LOG"
+exit 1
