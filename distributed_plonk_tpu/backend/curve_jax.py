@@ -266,8 +266,11 @@ def batch_to_affine(p):
 
     @jax.jit
     def prefix_suffix(z):
-        pre = jax.lax.associative_scan(mm, z, axis=1)
-        suf = jax.lax.associative_scan(mm, z, axis=1, reverse=True)
+        # single-width Hillis-Steele ladders, NOT associative_scan: the
+        # multi-width lowering wedged the remote TPU compile at SRS scale
+        # (round 4) — rationale at field_jax.cumprod_mont
+        pre = FJ.cumprod_mont(FQ, z)
+        suf = FJ.cumprod_mont(FQ, z, reverse=True)
         return pre, suf
 
     pre, suf = prefix_suffix(z)

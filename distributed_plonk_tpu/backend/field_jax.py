@@ -401,6 +401,37 @@ def mont_sq(spec, a):
     return mont_mul(spec, a, a)
 
 
+def cumprod_mont(spec, v, reverse=False):
+    """Inclusive prefix (or suffix) Montgomery products along axis 1 of a
+    (L, n) array, as a Hillis-Steele shift-multiply ladder.
+
+    NOT lax.associative_scan: the Blelchoch-style lowering runs ~2*log n
+    levels of DIFFERENT widths, which (a) instantiates one fused Pallas
+    multiplier per width — the resulting multi-Mosaic program wedged the
+    remote TPU compile twice at 2^18 scale (round 4) — and (b) even on
+    the XLA mul path produces an HLO whose compile never returned for
+    jit(perm_product). Here every level is ONE full-width mont_mul of
+    the SAME shape (identity-padded shift), so the whole ladder reuses a
+    single kernel instantiation: log n levels, n*log n muls instead of
+    ~2n — at 2^18 that is 4.7M extra lane-muls, milliseconds at the
+    measured mul rate, for a compile that returns in seconds.
+    """
+    L, n = v.shape
+    mont_one = (1 << (LIMB_BITS * spec.n_limbs)) % spec.mod
+    one_col = jnp.asarray(
+        int_to_limbs(mont_one, spec.n_limbs)).reshape(L, 1)
+    k = 1
+    while k < n:
+        ones = jnp.broadcast_to(one_col, (L, k))
+        if reverse:
+            shifted = jnp.concatenate([v[:, k:], ones], axis=1)
+        else:
+            shifted = jnp.concatenate([ones, v[:, :-k]], axis=1)
+        v = mont_mul(spec, v, shifted)
+        k *= 2
+    return v
+
+
 def is_zero(spec, a):
     return jnp.all(a == 0, axis=0)
 

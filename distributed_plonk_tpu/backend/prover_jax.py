@@ -11,8 +11,12 @@ all 5 rounds and only transcript scalars cross the host boundary mid-prove
 implemented round3*/round5* RPCs were sketching, src/hello_world.capnp:26-44).
 
 Everything here is O(1)-size traced: sequential recurrences become
-`associative_scan`s (prefix products / suffix sums) and fixed-exponent
-power ladders become bit-table scans.
+log-depth ladders — prefix PRODUCTS as the single-width Hillis-Steele
+shift-multiply ladder (field_jax.cumprod_mont; NOT associative_scan,
+whose multi-width lowering wedged the remote TPU compile at 2^18 —
+see that docstring before reintroducing one), suffix SUMS as an
+associative_scan over cheap adds, and fixed-exponent power ladders as
+bit-table scans.
 """
 
 from functools import partial
@@ -59,8 +63,10 @@ def _mm(a, b):
 
 
 def cumprod(v, reverse=False):
-    """Inclusive prefix (or suffix) products along axis 1 of (16, n)."""
-    return lax.associative_scan(_mm, v, axis=1, reverse=reverse)
+    """Inclusive prefix (or suffix) products along axis 1 of (16, n):
+    the single-width Hillis-Steele ladder (see field_jax.cumprod_mont for
+    why not associative_scan — the 2^18 remote-compile wedge)."""
+    return FJ.cumprod_mont(FR, v, reverse=reverse)
 
 
 def fr_pow(base, exp):

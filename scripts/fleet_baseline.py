@@ -45,9 +45,11 @@ def main():
     args = ap.parse_args()
 
     # the dispatcher side must also be CPU-pinned: RemoteBackend runs the
-    # round math locally between fleet calls
+    # round math locally between fleet calls (capture the scrubbed copy
+    # BEFORE clearing — scrubbed_cpu_env reads os.environ)
+    scrubbed = scrubbed_cpu_env()
     os.environ.clear()
-    os.environ.update(scrubbed_cpu_env())
+    os.environ.update(scrubbed)
 
     from distributed_plonk_tpu import kzg
     from distributed_plonk_tpu.prover import prove

@@ -36,8 +36,12 @@ if os.environ.get("DPT_MESH_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
+        # honor --devices (argparse has not run yet at import time)
+        _n = "8"
+        if "--devices" in sys.argv:
+            _n = sys.argv[sys.argv.index("--devices") + 1]
         os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8").strip()
+            _flags + f" --xla_force_host_platform_device_count={_n}").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -19,21 +19,29 @@ for attempt in $(seq 1 "$MAX"); do
   PID=$!
   last_cpu=""
   last_change=$(date +%s)
+  stalled=""
   while kill -0 "$PID" 2>/dev/null; do
     sleep 30
-    cpu=$(awk '{print $14+$15}' "/proc/$PID/stat" 2>/dev/null || echo "")
+    # sum utime+stime over the whole process GROUP (setsid above made
+    # $PID its own pgrp): a parent blocked in wait/recv while children
+    # do the work must not read as stalled. Empty sum (group already
+    # gone) -> loop top's kill -0 exits next round.
+    cpu=$(awk -v pg="$PID" '$5 == pg {s += $14 + $15} END {print s+0}' \
+          /proc/[0-9]*/stat 2>/dev/null || echo "")
+    kill -0 "$PID" 2>/dev/null || break
     now=$(date +%s)
     if [[ -n "$cpu" && "$cpu" != "$last_cpu" ]]; then
       last_cpu=$cpu
       last_change=$now
     elif (( now - last_change > STALL )); then
       echo "[watchdog] stall: no CPU progress for ${STALL}s, killing $PID" >> "$LOG"
+      stalled=1
       kill -9 -- "-$PID" 2>/dev/null || kill -9 "$PID" 2>/dev/null
       wait "$PID" 2>/dev/null
       break
     fi
   done
-  if wait "$PID" 2>/dev/null; then
+  if [[ -z "$stalled" ]] && wait "$PID" 2>/dev/null; then
     echo "[watchdog] attempt $attempt succeeded" >> "$LOG"
     exit 0
   fi
