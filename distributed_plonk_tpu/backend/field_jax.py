@@ -432,6 +432,24 @@ def cumprod_mont(spec, v, reverse=False):
     return v
 
 
+def cumsum_mont(spec, v, reverse=False):
+    """Inclusive prefix (or suffix) modular sums along axis 1 of (L, n):
+    the zero-padded Hillis-Steele ladder — same single-width rationale as
+    cumprod_mont (every level one full-width add of the same shape; no
+    multi-width associative_scan lowering near the remote compiler)."""
+    L, n = v.shape
+    k = 1
+    while k < n:
+        zeros = jnp.zeros((L, k), v.dtype)
+        if reverse:
+            shifted = jnp.concatenate([v[:, k:], zeros], axis=1)
+        else:
+            shifted = jnp.concatenate([zeros, v[:, :-k]], axis=1)
+        v = add(spec, v, shifted)
+        k *= 2
+    return v
+
+
 def is_zero(spec, a):
     return jnp.all(a == 0, axis=0)
 

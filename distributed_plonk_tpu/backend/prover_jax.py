@@ -14,9 +14,9 @@ Everything here is O(1)-size traced: sequential recurrences become
 log-depth ladders — prefix PRODUCTS as the single-width Hillis-Steele
 shift-multiply ladder (field_jax.cumprod_mont; NOT associative_scan,
 whose multi-width lowering wedged the remote TPU compile at 2^18 —
-see that docstring before reintroducing one), suffix SUMS as an
-associative_scan over cheap adds, and fixed-exponent power ladders as
-bit-table scans.
+see that docstring before reintroducing one), suffix SUMS as the
+zero-padded add ladder (field_jax.cumsum_mont), and fixed-exponent
+power ladders as bit-table scans.
 """
 
 from functools import partial
@@ -257,8 +257,9 @@ def synthetic_divide(poly, zc):
     pw = jnp.concatenate([_one_like(poly[:, :1]), cumprod(z_rep)[:, :L - 1]],
                          axis=1)  # z^t
     g = _mm(poly, pw)
-    # suffix sums via reverse associative scan with field add
-    s = lax.associative_scan(partial(FJ.add, FR), g, axis=1, reverse=True)
+    # suffix sums via the single-width add ladder (same remote-compile
+    # rationale as cumprod: no multi-width associative_scan lowerings)
+    s = FJ.cumsum_mont(FR, g, reverse=True)
     s_next = s[:, 1:]  # S_{j+1}, j = 0..L-2
     ipw = cumprod(jnp.broadcast_to(zinv, (FR_LIMBS, L - 1)))  # z^-(j+1)
     return _mm(s_next, ipw)
