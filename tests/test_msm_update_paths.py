@@ -15,9 +15,11 @@ from distributed_plonk_tpu.backend import msm_jax as M
 RNG = random.Random(0x1407)
 
 
-@pytest.mark.parametrize("mode", ["put", "onehot"])
-def test_update_strategies_match_oracle(mode, monkeypatch):
+@pytest.mark.parametrize("mode,pack", [
+    ("put", True), ("onehot", True), ("onehot", False)])
+def test_update_strategies_match_oracle(mode, pack, monkeypatch):
     monkeypatch.setattr(M, "_BUCKET_UPDATE", mode)
+    monkeypatch.setattr(M, "_PLANE_PACK", pack)
     # the strategy branch is resolved at trace time inside jitted scans:
     # drop cached executables so the patched mode actually traces
     jax.clear_caches()
