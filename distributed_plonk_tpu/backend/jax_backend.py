@@ -179,14 +179,20 @@ class JaxBackend:
     def ifft_h(self, domain, h):
         return self._kernel(domain, h, True, False)
 
-    # batch NTTs run as single multi-poly launches, chunked so the Fr
-    # mont_mul column tensor (16*16 * B * n * 4B ~ 1 KB per element) stays
-    # ~2 GB: B*n <= 2^21. DPT_NTT_BATCH caps the chunk width
+    # batch NTTs run as single multi-poly launches, chunked by a B*n cap.
+    # The XLA f32 mul path materializes its column tensor (~1 KB/elem) so
+    # it needs B*n <= 2^21 (~2 GB transient); the fused Pallas multiplier
+    # keeps those in VMEM, so the cap rises to 2^23 (working set is then
+    # the (16, B, n) stage arrays, ~0.5 GB per copy at the cap) — at the
+    # 2^21 quotient domain that turns round 3's 25 per-poly coset-FFT
+    # launches into 7, saving ~18 x the ~120 ms per-call dispatch.
+    # DPT_NTT_BATCH caps the chunk width.
     _NTT_BATCH = int(os.environ.get("DPT_NTT_BATCH", "8"))
 
     def _kernel_many(self, domain, hs, inverse, coset):
         plan = ntt_jax.get_plan(domain.size)
-        chunk = max(1, min(self._NTT_BATCH, (1 << 21) // domain.size))
+        elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
+        chunk = max(1, min(self._NTT_BATCH, elems_cap // domain.size))
         padded = [jnp.pad(h, ((0, 0), (0, domain.size - h.shape[1])))
                   if h.shape[1] < domain.size else h for h in hs]
         if chunk == 1:
