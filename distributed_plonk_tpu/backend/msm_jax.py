@@ -692,15 +692,39 @@ class MsmContext:
 
     def _run_batches(self, items, make_digits):
         """items -> affine points; digits are materialized per batch chunk
-        so peak digit memory is _BATCH_CHUNK tensors, not len(items)."""
+        so peak digit memory is _BATCH_CHUNK tensors, not len(items).
+
+        Double-buffered: batch k's (24, B) device totals convert to host
+        only AFTER batch k+1's work is enqueued, so the device never sits
+        idle behind the host-side decode fence (the totals are tiny; only
+        ONE extra batch's queued work is ever outstanding)."""
         out = []
-        for i in range(0, len(items), self._BATCH_CHUNK):
-            digits = jnp.stack(
-                [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
-            tx, ty, tz = self._exec_chunked(digits)
+        pending = None  # (batch_width, device totals) awaiting decode
+
+        def drain(p):
+            B, (tx, ty, tz) = p
             tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
             out.extend(_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
-                       for j in range(digits.shape[0]))
+                       for j in range(B))
+
+        for i in range(0, len(items), self._BATCH_CHUNK):
+            # until the one-shot adds/s calibration has latched, drain the
+            # previous batch BEFORE launching (old behavior): otherwise the
+            # calibration fence inside _exec_chunked would time the timed
+            # chunk PLUS the whole queued previous batch and latch a
+            # permanently under-read rate
+            if (pending is not None and self._calib_key()
+                    not in MsmContext._measured_adds_per_s):
+                drain(pending)
+                pending = None
+            digits = jnp.stack(
+                [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
+            totals = self._exec_chunked(digits)
+            if pending is not None:
+                drain(pending)
+            pending = (digits.shape[0], totals)
+        if pending is not None:
+            drain(pending)
         return out
 
     def msm_mont_limbs_many(self, hs):

@@ -25,27 +25,9 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
-# Default to the virtual CPU mesh (same recipe as tests/conftest.py: the
-# axon sitecustomize imports jax at interpreter startup, so the env alone
-# is not enough — pin the in-process config too). DPT_MESH_PLATFORM=real
-# skips the forcing for an actual multi-chip pod.
-if os.environ.get("DPT_MESH_PLATFORM", "cpu") == "cpu":
-    for _k in list(os.environ):
-        if _k.startswith(("PALLAS_AXON", "AXON_", "TPU_")):
-            os.environ.pop(_k)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        # honor --devices (argparse has not run yet at import time)
-        _n = "8"
-        if "--devices" in sys.argv:
-            _n = sys.argv[sys.argv.index("--devices") + 1]
-        os.environ["XLA_FLAGS"] = (
-            _flags + f" --xla_force_host_platform_device_count={_n}").strip()
-    import jax
+from _mesh_env import force_cpu_mesh
 
-    jax.config.update("jax_platforms", "cpu")
-
+force_cpu_mesh()
 
 def main():
     ap = argparse.ArgumentParser()
