@@ -138,9 +138,13 @@ def g1_jac_add(j1, j2):
     return (X3, Y3, Z3)
 
 
-def g1_mul(p, k):
-    """Scalar multiplication (double-and-add, jacobian)."""
-    k %= R_MOD
+def g1_mul(p, k, reduce=True):
+    """Scalar multiplication (double-and-add, jacobian).
+
+    reduce=False keeps k unreduced mod r — needed by subgroup checks
+    (r·p = O?), where reducing would turn the check into 0·p."""
+    if reduce:
+        k %= R_MOD
     acc = (1, 1, 0)
     base = g1_to_jac(p)
     while k > 0:
@@ -220,8 +224,9 @@ def g2_add(p, q):
     return (x3, y3)
 
 
-def g2_mul(p, k):
-    k %= R_MOD
+def g2_mul(p, k, reduce=True):
+    if reduce:
+        k %= R_MOD
     acc = None
     base = p
     while k > 0:

@@ -21,10 +21,27 @@ Format (compressed): 48 bytes (G1) / 96 bytes (G2), big-endian x
 Uncompressed: 96 / 192 bytes, x then y, flags bit7=bit5=0.
 """
 
-from .constants import Q_MOD
+from .constants import Q_MOD, R_MOD
 from . import curve as C
 
 _HALF = (Q_MOD - 1) // 2
+
+
+def _g1_in_subgroup(p):
+    """True iff affine p lies in the r-order subgroup (r·p = O).
+
+    BLS12-381's G1 cofactor is ≈2^125, so on-curve points outside the
+    prime-order subgroup exist and the zcash/IETF format requires
+    rejecting them (draft-irtf-cfrg-pairing-friendly-curves, appendix C).
+    reduce=False: reducing r mod r would turn the check into 0·p.
+    Host-oracle scale (255 Jacobian steps)."""
+    return C.g1_mul(p, R_MOD, reduce=False) is None
+
+
+def _g2_in_subgroup(p):
+    """True iff affine G2 p satisfies r·p = O (cofactor ≈2^378 — almost
+    every on-curve point is OUTSIDE the subgroup)."""
+    return C.g2_mul(p, R_MOD, reduce=False) is None
 
 
 def _fq_sign(y):
@@ -56,8 +73,8 @@ def g1_to_zcash(p, compressed=True):
 
 def g1_from_zcash(b):
     """48/96 zcash-format bytes -> affine G1 or None. Validates flags,
-    field range, curve membership and (for the canonical format) the
-    subgroup via cofactor-cleared order check."""
+    field range, curve membership and the r-order subgroup (r·p = O),
+    per the zcash/IETF validation rules."""
     b = bytes(b)
     if len(b) not in (48, 96):
         raise ValueError("G1 encoding must be 48 or 96 bytes")
@@ -80,6 +97,8 @@ def g1_from_zcash(b):
             raise ValueError("x is not on the curve")
         if _fq_sign(y) != sign:
             y = (Q_MOD - y) % Q_MOD
+        if not _g1_in_subgroup((x, y)):
+            raise ValueError("point not in the r-order subgroup")
         return (x, y)
     if sign or (b[0] & 0x20):
         raise ValueError("sign flag set on uncompressed encoding")
@@ -89,6 +108,8 @@ def g1_from_zcash(b):
         raise ValueError("coordinate out of range")
     if not C.g1_is_on_curve((x, y)):
         raise ValueError("point not on curve")
+    if not _g1_in_subgroup((x, y)):
+        raise ValueError("point not in the r-order subgroup")
     return (x, y)
 
 
@@ -108,7 +129,8 @@ def g2_to_zcash(p, compressed=True):
 
 
 def g2_from_zcash(b):
-    """96/192 zcash-format bytes -> affine G2 or None."""
+    """96/192 zcash-format bytes -> affine G2 or None. Same validation
+    surface as g1_from_zcash, including the r-order subgroup check."""
     b = bytes(b)
     if len(b) not in (96, 192):
         raise ValueError("G2 encoding must be 96 or 192 bytes")
@@ -131,7 +153,10 @@ def g2_from_zcash(b):
             raise ValueError("x is not on the curve")
         if _fq2_sign(y) != sign:
             y = ((Q_MOD - y[0]) % Q_MOD, (Q_MOD - y[1]) % Q_MOD)
-        return ((x0, x1), y)
+        p = ((x0, x1), y)
+        if not _g2_in_subgroup(p):
+            raise ValueError("point not in the r-order subgroup")
+        return p
     if sign:
         raise ValueError("sign flag set on uncompressed encoding")
     y1 = int.from_bytes(b[96:144], "big")
@@ -141,6 +166,8 @@ def g2_from_zcash(b):
     p = ((x0, x1), (y0, y1))
     if not C.g2_is_on_curve(p):
         raise ValueError("point not on curve")
+    if not _g2_in_subgroup(p):
+        raise ValueError("point not in the r-order subgroup")
     return p
 
 

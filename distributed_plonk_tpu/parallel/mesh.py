@@ -8,10 +8,29 @@ jax.distributed and letting jax.devices() span hosts (DCN), with the same
 mesh axis semantics.
 """
 
+import contextlib
+
 import numpy as np
 import jax
 
 SHARD_AXIS = "shards"
+
+
+def pallas_guard(mesh):
+    """Context manager for TRACING mesh programs: disables the Pallas
+    mont_mul dispatch unless the mesh's own devices are TPUs.
+
+    field_jax._use_pallas keys off jax.default_backend(), which is the
+    PROCESS default — on a host where a TPU plugin outranks JAX_PLATFORMS
+    (the axon tunnel), a virtual CPU mesh traced in a TPU-default process
+    would otherwise emit Mosaic pallas_calls that cannot lower for CPU
+    execution (observed: cpu_aot_loader KeyError crash in the bucket
+    scan). On a real TPU mesh this is a no-op and the kernels stay."""
+    from ..backend import field_jax as FJ
+
+    if mesh.devices.ravel()[0].platform == "tpu":
+        return contextlib.nullcontext()
+    return FJ.pallas_disabled()
 
 
 def init_multihost(coordinator, num_processes, process_id,

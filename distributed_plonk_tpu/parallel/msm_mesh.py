@@ -48,6 +48,7 @@ except ImportError:  # pragma: no cover - older jax
 from ..constants import FQ_LIMBS
 from ..backend import msm_jax
 from ..backend import curve_jax as CJ
+from ..backend import field_jax as FJ
 from ..backend.msm_jax import (
     SCALAR_BITS, DeviceCommitKey, window_bits, _group_size_batch,
     bucket_planes_batch, bucket_planes_batch_signed, fold_planes,
@@ -55,7 +56,7 @@ from ..backend.msm_jax import (
     digits_from_mont, signed_digits_from_mont, points_to_device,
     _proj_limbs_to_affine,
 )
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, pallas_guard
 
 
 class MeshMsmContext:
@@ -108,7 +109,18 @@ class MeshMsmContext:
         self._digits_fns = {}
         self._chunk_fns = {}
         self._finish_fns = {}
-        self._merge_fn = jax.jit(lambda a, b: CJ.proj_add(tuple(a), tuple(b)))
+
+        # pallas_disabled at TRACE time: this jit runs on mesh-replicated
+        # operands under the GSPMD partitioner, where a pallas_call (no
+        # SPMD partitioning rule) would fail to partition or silently
+        # gather — same invariant as MeshBackend's round math. The
+        # explicit shard_map chunk bodies keep the kernel (per-device
+        # local shapes).
+        def _merge(a, b):
+            with FJ.pallas_disabled():
+                return CJ.proj_add(tuple(a), tuple(b))
+
+        self._merge_fn = jax.jit(_merge)
 
     # --- digit extraction ----------------------------------------------------
 
@@ -129,14 +141,18 @@ class MeshMsmContext:
             W, d, loc = self.windows, self.d, self.local_n
 
             def build(handles):
-                outs = []
-                for h in handles:
-                    if self.signed:
-                        dg = signed_digits_from_mont(h, self.padded_n)
-                    else:
-                        dg = digits_from_mont(h, self.c, self.padded_n)
-                    outs.append(dg.reshape(W, d, loc))
-                return jnp.stack(outs)
+                # pallas_disabled: handles arrive mesh-sharded and this
+                # jit is GSPMD-partitioned (not shard_map'd) — a traced
+                # pallas mont_mul here would break on a real TPU mesh
+                with FJ.pallas_disabled():
+                    outs = []
+                    for h in handles:
+                        if self.signed:
+                            dg = signed_digits_from_mont(h, self.padded_n)
+                        else:
+                            dg = digits_from_mont(h, self.c, self.padded_n)
+                        outs.append(dg.reshape(W, d, loc))
+                    return jnp.stack(outs)
 
             fn = jax.jit(build, out_shardings=self._digits_sh)
             self._digits_fns[key] = fn
@@ -153,16 +169,18 @@ class MeshMsmContext:
                     else bucket_planes_batch)
 
             def body(ax, ay, ainf, digits):
-                # local block: ax/ay (24, 1, jc), ainf (1, jc),
-                # digits (B, W, 1, jc)
-                acc = scan(ax[:, 0], ay[:, 0], ainf[0],
-                           digits[:, :, 0], group=group)
-                # fold bucket planes across the mesh on device (the
-                # reference folds partial totals on the dispatcher host,
-                # dispatcher2.rs:888-890); the fold body is identical to
-                # the group fold's -> compiled once
-                gathered = tuple(lax.all_gather(b, SHARD_AXIS) for b in acc)
-                return fold_planes(*gathered)
+                # pallas only if the mesh devices are TPUs (mesh.pallas_guard)
+                with pallas_guard(self.mesh):
+                    # local block: ax/ay (24, 1, jc), ainf (1, jc),
+                    # digits (B, W, 1, jc)
+                    acc = scan(ax[:, 0], ay[:, 0], ainf[0],
+                               digits[:, :, 0], group=group)
+                    # fold bucket planes across the mesh on device (the
+                    # reference folds partial totals on the dispatcher host,
+                    # dispatcher2.rs:888-890); the fold body is identical to
+                    # the group fold's -> compiled once
+                    gathered = tuple(lax.all_gather(b, SHARD_AXIS) for b in acc)
+                    return fold_planes(*gathered)
 
             # check_vma=False: the all_gather+fold makes the outputs
             # replicated in value, which the varying-axes checker cannot
@@ -176,8 +194,11 @@ class MeshMsmContext:
 
     def _finish_fn(self, batch):
         if batch not in self._finish_fns:
-            self._finish_fns[batch] = jax.jit(
-                partial(finish_batch, batch=batch, signed=self.signed))
+            def _finish(ax, ay, az):
+                with pallas_guard(self.mesh):
+                    return finish_batch(ax, ay, az, batch=batch,
+                                        signed=self.signed)
+            self._finish_fns[batch] = jax.jit(_finish)
         return self._finish_fns[batch]
 
     def _exec(self, digits):

@@ -42,7 +42,7 @@ from ..backend import field_jax as FJ
 from ..backend.field_jax import FR
 from ..backend import ntt_jax
 from ..backend.limbs import ints_to_limbs, limbs_to_ints
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, pallas_guard
 
 
 def _split_rc(n):
@@ -166,15 +166,23 @@ class MeshNttPlan:
 
         @jax.jit
         def fn(x, cs):
-            # x: (16, n) global
-            if plain:
-                x = FJ.to_mont(FR, x)
-            a = x.reshape(FR_LIMBS, r, c).swapaxes(1, 2)  # A[j2, j1]
-            out = smapped(a, cs)                           # (16, r, c) = X[k1, k2]
-            x = out.swapaxes(1, 2).reshape(FR_LIMBS, n)    # X[k1 + r*k2]
-            if plain:
-                x = FJ.from_mont(FR, x)
-            return x
+            # pallas only if the MESH devices are TPUs (a cpu mesh can be
+            # traced in a tpu-default process — mesh.pallas_guard); the
+            # plain-boundary conversions run OUTSIDE shard_map at the
+            # GSPMD level, where a pallas_call must never appear even on
+            # a real TPU mesh (same invariant as MeshBackend round math)
+            with pallas_guard(self.mesh):
+                # x: (16, n) global
+                if plain:
+                    with FJ.pallas_disabled():
+                        x = FJ.to_mont(FR, x)
+                a = x.reshape(FR_LIMBS, r, c).swapaxes(1, 2)  # A[j2, j1]
+                out = smapped(a, cs)                       # (16, r, c) = X[k1, k2]
+                x = out.swapaxes(1, 2).reshape(FR_LIMBS, n)  # X[k1 + r*k2]
+                if plain:
+                    with FJ.pallas_disabled():
+                        x = FJ.from_mont(FR, x)
+                return x
 
         self._fns[key] = (fn, consts)
         return lambda v: fn(v, consts)

@@ -99,3 +99,42 @@ def test_malformed_rejected():
     bad[0] |= 0x80
     with pytest.raises(ValueError):
         E.g1_from_zcash(bytes(bad))
+
+
+def test_g1_non_subgroup_rejected():
+    """ADVICE r4: decoders must reject on-curve points OUTSIDE the
+    r-order subgroup (G1 cofactor ≈2^125). Search a small on-curve x
+    deterministically; such a point is in the subgroup only with
+    probability ~2^-125."""
+    from distributed_plonk_tpu.constants import Q_MOD
+    x = 0
+    while True:
+        y2 = (pow(x, 3, Q_MOD) + 4) % Q_MOD
+        y = pow(y2, (Q_MOD + 1) // 4, Q_MOD)
+        if y * y % Q_MOD == y2:
+            p = (x, y)
+            if not E._g1_in_subgroup(p):
+                break
+        x += 1
+    for comp in (True, False):
+        with pytest.raises(ValueError, match="subgroup"):
+            E.g1_from_zcash(E.g1_to_zcash(p, compressed=comp))
+    # sanity: subgroup members still decode
+    assert E.g1_from_zcash(G1_GEN_COMPRESSED) == C.G1_GEN
+
+
+def test_g2_non_subgroup_rejected():
+    """Same for G2, whose cofactor is ≈2^378 — almost every on-curve
+    point fails the subgroup check."""
+    x0 = 0
+    while True:
+        y = E._fq2_sqrt(E._fq2_add(E._fq2_mul_xx_x((x0, 0)), (4, 4)))
+        if y is not None:
+            p = ((x0, 0), y)
+            if not E._g2_in_subgroup(p):
+                break
+        x0 += 1
+    for comp in (True, False):
+        with pytest.raises(ValueError, match="subgroup"):
+            E.g2_from_zcash(E.g2_to_zcash(p, compressed=comp))
+    assert E.g2_from_zcash(G2_GEN_COMPRESSED) == C.G2_GEN

@@ -9,6 +9,7 @@ in-process device mesh instead of a live 2-host cluster (SURVEY.md §4's
 
 import random
 
+import jax
 import pytest
 
 from distributed_plonk_tpu import poly as P
@@ -59,6 +60,45 @@ def test_mesh_ntt_roundtrip_uneven_rc(mesh8):
     domain = P.Domain(512)
     assert plan.run_ints(values) == P.fft(domain, values)
     assert plan.run_ints(plan.run_ints(values), inverse=True) == values
+
+
+def test_mesh_commit_paths_never_dispatch_pallas(mesh8, monkeypatch):
+    """ADVICE r4 regression: _digits_of_handles and _merge_fn trace
+    mont_mul on GSPMD-sharded/replicated operands OUTSIDE shard_map,
+    where a pallas_call (no SPMD partitioning rule) breaks on a real TPU
+    mesh. Force the pallas dispatch mode at any width and assert those
+    jits never reach the pallas kernel — while still extracting correct
+    digits."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.backend import field_jax as FJ
+    from distributed_plonk_tpu.backend import field_pallas as FP
+    from distributed_plonk_tpu.backend.limbs import ints_to_limbs
+    from distributed_plonk_tpu.constants import FR_MONT_R
+
+    monkeypatch.setattr(FJ, "_MUL_MODE", "pallas")
+    monkeypatch.setattr(FJ, "_PALLAS_MIN_LANES", 1)
+    hits = []
+    real_mul = FP.mont_mul
+
+    def spy(spec, a, b):
+        hits.append(a.shape)
+        return real_mul(spec, a, b)
+
+    monkeypatch.setattr(FP, "mont_mul", spy)
+
+    n = 64
+    pts = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD)) for _ in range(8)]
+    ctx = MeshMsmContext(mesh8, [pts[i % 8] for i in range(n)])
+    scalars = [RNG.randrange(R_MOD) for _ in range(n)]
+    h = jnp.asarray(ints_to_limbs([s * FR_MONT_R % R_MOD for s in scalars], 16))
+    digits = ctx._digits_of_handles([h])
+    assert not hits, f"pallas dispatched in sharded digit extraction: {hits}"
+    assert np.array_equal(np.asarray(digits)[0], ctx._digits_np(scalars))
+
+    planes = tuple(jnp.ones((24, 8, 16), jnp.uint32) for _ in range(3))
+    jax.block_until_ready(ctx._merge_fn(planes, planes))
+    assert not hits, f"pallas dispatched in the cross-chunk merge: {hits}"
 
 
 def test_mesh_msm_matches_oracle(mesh8):
