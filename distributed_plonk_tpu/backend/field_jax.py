@@ -242,6 +242,23 @@ def _use_pallas(shape):
     return jax.default_backend() == "tpu"
 
 
+def pack_limb_pairs(v):
+    """(2K, ...) u32 16-bit limbs -> (K, ...) u32 packed pairs (lo | hi<<16).
+
+    Layout compression for RESIDENT arrays, not an arithmetic form: kernels
+    unpack slices on the fly. Used by the MSM bucket-plane scan carries and
+    round 3's coset-eval set (whose 25 polynomials at 8n were the measured
+    single-chip 2^19 OOM, scale_2p19_r04.log)."""
+    return v[0::2] | jnp.left_shift(v[1::2], 16)
+
+
+def unpack_limb_pairs(p):
+    """(K, ...) packed pairs -> (2K, ...) u32 16-bit limbs."""
+    lo = p & 0xFFFF
+    hi = jnp.right_shift(p, 16)
+    return jnp.stack([lo, hi], axis=1).reshape((2 * p.shape[0],) + p.shape[1:])
+
+
 def _bytes_f32(a):
     """(L, *b) u32 16-bit limbs -> (2L, *b) f32 radix-2^8 digits."""
     lo = (a & 0xFF).astype(jnp.float32)

@@ -48,6 +48,7 @@ class JaxBackend:
         self._circuit_tabs = {}
         self._pk_polys = {}
         self._domain_tabs = {}
+        self._domain_tabs_packed = {}
         # guards check-then-insert on the capped caches: the worker daemon
         # runs kernels outside its state lock, so two connections can hit a
         # backend cache concurrently (an eviction between check and read
@@ -189,19 +190,34 @@ class JaxBackend:
     # DPT_NTT_BATCH caps the chunk width.
     _NTT_BATCH = int(os.environ.get("DPT_NTT_BATCH", "8"))
 
-    def _kernel_many(self, domain, hs, inverse, coset):
+    @staticmethod
+    def _pad_to(h, size):
+        # padding happens PER BATCH, never up front: materializing all 25
+        # round-3 inputs at the quotient-domain width was 6.4 GB of
+        # transient at m=2^22 — the dominant term of the measured 2^19
+        # OOM (scale_2p19_r05.log attempt 1); inputs stay at their n-scale
+        # widths until the launch that consumes them
+        return (jnp.pad(h, ((0, 0), (0, size - h.shape[1])))
+                if h.shape[1] < size else h)
+
+    def _kernel_many(self, domain, hs, inverse, coset, post=None):
+        """B NTTs in capped batches; `post` (if given) maps each launch's
+        (16, B, m) result before results are split out — e.g. the round-3
+        limb packing, applied while at most one batch is unpacked."""
         plan = ntt_jax.get_plan(domain.size)
         elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
         chunk = max(1, min(self._NTT_BATCH, elems_cap // domain.size))
-        padded = [jnp.pad(h, ((0, 0), (0, domain.size - h.shape[1])))
-                  if h.shape[1] < domain.size else h for h in hs]
         if chunk == 1:
             fn1 = plan.kernel(inverse=inverse, coset=coset, boundary="mont")
-            return [fn1(h) for h in padded]
+            one = ((lambda h: post(fn1(h))) if post else fn1)
+            return [one(self._pad_to(h, domain.size)) for h in hs]
         fn = plan.kernel_batch(inverse=inverse, coset=coset)
         out = []
-        for i in range(0, len(padded), chunk):
-            res = fn(jnp.stack(padded[i:i + chunk], axis=1))
+        for i in range(0, len(hs), chunk):
+            res = fn(jnp.stack([self._pad_to(h, domain.size)
+                                for h in hs[i:i + chunk]], axis=1))
+            if post is not None:
+                res = post(res)
             out.extend(res[:, j] for j in range(res.shape[1]))
         return out
 
@@ -210,6 +226,59 @@ class JaxBackend:
 
     def coset_fft_many(self, domain, hs):
         return self._kernel_many(domain, hs, False, True)
+
+    # --- packed round 3 ------------------------------------------------------
+    # The single-device memory strategy for the quotient round: coset evals
+    # live LIMB-PACKED (8, m) — two 16-bit limbs per u32 — and the quotient
+    # evaluation runs in lane slices that unpack on the fly. Together these
+    # halve the ~7 GB coset-eval residency that OOM'd n=2^19 on one chip
+    # (scale_2p19_r04.log; the working set is inherent to the reference's
+    # round-3 quotient pipeline, /root/reference/src/dispatcher2.rs:382-507).
+    # The mesh backend opts out (packed_round3 = False): there the memory
+    # strategy is sharding, and slicing a GSPMD-sharded lane axis would
+    # reshard every chunk.
+
+    packed_round3 = True
+    _QUOT_SLICE = int(os.environ.get("DPT_QUOT_SLICE", str(1 << 20)))
+
+    def coset_fft_many_packed(self, domain, hs):
+        """coset_fft_many, but each (16, m) result returns limb-packed
+        (8, m). Packing rides the launch loop so at most one batch of
+        unpacked outputs is ever resident."""
+        return self._kernel_many(domain, hs, False, True, post=PJ.pack_jit)
+
+    def _domain_tables_packed(self, m, n, group_gen):
+        key = (m, n)
+        with self._cache_lock:
+            hit = self._domain_tabs_packed.get(key)
+        if hit is None:
+            tabs = PJ.domain_tables_jit(m, n, FR_GENERATOR, group_gen)
+            hit = {kk: PJ.pack_jit(v) for kk, v in tabs.items()}
+            with self._cache_lock:
+                self._domain_tabs_packed[key] = hit
+        return hit
+
+    def quotient_packed(self, n, m, quot_domain, k, beta, gamma, alpha,
+                        alpha_sq_div_n, sel_p, sig_p, wir_p, z_p, pi_p):
+        """Quotient evaluations from packed (8, m) coset planes, computed
+        in DPT_QUOT_SLICE-lane slices through ONE compiled program (the
+        slice offset is a traced scalar). Returns unpacked (16, m) evals
+        for the coset iFFT."""
+        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
+        ratio = m // n
+        z_next_p = PJ.roll_jit(z_p, ratio)
+        chunk = min(self._QUOT_SLICE, m)
+        assert m % chunk == 0
+        k_arr = jnp.asarray(PJ.lift(list(k))).reshape(FR_LIMBS, len(k), 1)
+        scal = [jnp.asarray(PJ.lift_scalar(x))
+                for x in (beta, gamma, alpha, alpha_sq_div_n)]
+        outs = []
+        for j0 in range(0, m, chunk):
+            outs.append(PJ.quotient_slice_jit(
+                list(sel_p), list(sig_p), list(wir_p), z_p, z_next_p, pi_p,
+                tabs["ep"], tabs["zh_inv"], tabs["shifted_inv"],
+                k_arr, *scal, np.uint32(j0), chunk=chunk))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
     def coset_fft_h(self, domain, h):
         return self._kernel(domain, h, False, True)
@@ -305,6 +374,16 @@ class JaxBackend:
         """Host (16, w*n) limb array -> (16, w, n) device table (placement
         hook, like _lift_arr)."""
         return jnp.asarray(arr).reshape(FR_LIMBS, w, n)
+
+    def release_circuit_tables(self, circuit):
+        """Free the witness/permutation device tables (≈0.5 GB at n=2^19).
+
+        The prover calls this after round 2 — wire_values (round 1) and
+        perm_product (round 2) are the only consumers — so the HBM is
+        available to round 3's coset planes. A subsequent prove of the
+        same circuit re-lifts them (one O(n) upload)."""
+        with self._cache_lock:
+            self._circuit_tabs.pop(id(circuit), None)
 
     def perm_product(self, circuit, beta, gamma, n):
         tabs = self._circuit_tables(circuit)

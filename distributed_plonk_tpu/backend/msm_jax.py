@@ -129,17 +129,10 @@ def _use_packed_planes():
     return _use_onehot_update() and _PLANE_PACK
 
 
-def _pack_limbs(v):
-    """(2K, ...) u32 16-bit limbs -> (K, ...) u32 packed pairs."""
-    return v[0::2] | jnp.left_shift(v[1::2], 16)
-
-
-def _unpack_limbs(p):
-    """(K, ...) packed pairs -> (2K, ...) u32 16-bit limbs."""
-    lo = p & 0xFFFF
-    hi = jnp.right_shift(p, 16)
-    K = p.shape[0]
-    return jnp.stack([lo, hi], axis=1).reshape((2 * K,) + p.shape[1:])
+# packed-pair layout shared with field_jax (round 3's packed coset evals
+# use the same representation)
+_pack_limbs = FJ.pack_limb_pairs
+_unpack_limbs = FJ.unpack_limb_pairs
 
 
 def _plane_init(proj_planes):

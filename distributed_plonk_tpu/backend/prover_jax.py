@@ -159,13 +159,15 @@ def _pow5(x):
     return _mm(_mm(x2, x2), x)
 
 
-def quotient_evals(selectors, sigmas, wires, z, pi, tabs, k, beta, gamma,
-                   alpha, alpha_sq_div_n, ratio):
+def quotient_evals_core(selectors, sigmas, wires, z, z_next, pi, ep, zh_inv,
+                        shifted_inv, k, beta, gamma, alpha, alpha_sq_div_n):
     """Coset evaluations of the quotient polynomial, fully elementwise on m
     lanes (the reference's serial O(m) loop, src/dispatcher2.rs:434-504).
 
-    selectors: (16, 13, m); sigmas/wires: (16, 5, m); z/pi: (16, m);
-    tabs: domain_tables(...); k: (16, 5, 1); challenge scalars (16, 1).
+    selectors: (16, 13, m); sigmas/wires: (16, 5, m); z/z_next/pi: (16, m);
+    ep/zh_inv/shifted_inv: (16, m) domain tables; k: (16, 5, 1); challenge
+    scalars (16, 1). z_next is z rolled by -m/n (precomputed by the caller
+    so m can be SLICED: every other input is pointwise in the lane index).
     Selector order matches circuit.py (Q_LC x4, Q_MUL x2, Q_HASH x4, Q_O,
     Q_C, Q_ECC)."""
     m = z.shape[1]
@@ -182,21 +184,56 @@ def quotient_evals(selectors, sigmas, wires, z, pi, tabs, k, beta, gamma,
     gate = FJ.add(FR, gate, _mm(selectors[:, 12], _mm(_mm(ab, cd), e)))
     gate = FJ.sub(FR, gate, _mm(selectors[:, 10], e))
 
-    z_next = jnp.roll(z, -ratio, axis=1)
     acc1 = z
     acc2 = z_next
     beta_b = jnp.broadcast_to(beta, (FR_LIMBS, m))
     for j in range(5):
         t = FJ.add(FR, wires[:, j], jnp.broadcast_to(gamma, (FR_LIMBS, m)))
-        acc1 = _mm(acc1, FJ.add(FR, t, _mm(_mm(jnp.broadcast_to(k[:, j], (FR_LIMBS, m)), tabs["ep"]), beta_b)))
+        acc1 = _mm(acc1, FJ.add(FR, t, _mm(_mm(jnp.broadcast_to(k[:, j], (FR_LIMBS, m)), ep), beta_b)))
         acc2 = _mm(acc2, FJ.add(FR, t, _mm(sigmas[:, j], beta_b)))
     perm = _mm(jnp.broadcast_to(alpha, (FR_LIMBS, m)), FJ.sub(FR, acc1, acc2))
 
     one = _one_like(z)
     l1 = _mm(_mm(jnp.broadcast_to(alpha_sq_div_n, (FR_LIMBS, m)),
-                 FJ.sub(FR, z, one)), tabs["shifted_inv"])
-    out = FJ.add(FR, _mm(tabs["zh_inv"], FJ.add(FR, gate, perm)), l1)
+                 FJ.sub(FR, z, one)), shifted_inv)
+    out = FJ.add(FR, _mm(zh_inv, FJ.add(FR, gate, perm)), l1)
     return out
+
+
+def quotient_evals(selectors, sigmas, wires, z, pi, tabs, k, beta, gamma,
+                   alpha, alpha_sq_div_n, ratio):
+    """One-shot quotient evaluation over the full domain (the unpacked
+    path: host-oracle-shaped backends and the mesh backend, whose GSPMD
+    sharding replaces slicing as the memory strategy)."""
+    z_next = jnp.roll(z, -ratio, axis=1)
+    return quotient_evals_core(
+        selectors, sigmas, wires, z, z_next, pi, tabs["ep"], tabs["zh_inv"],
+        tabs["shifted_inv"], k, beta, gamma, alpha, alpha_sq_div_n)
+
+
+def quotient_slice(sel_p, sig_p, wir_p, z_p, z_next_p, pi_p, ep_p, zh_inv_p,
+                   shifted_inv_p, k, beta, gamma, alpha, alpha_sq_div_n, j0,
+                   *, chunk):
+    """One `chunk`-wide slice of the quotient evaluation from LIMB-PACKED
+    (8, m) inputs (field_jax.pack_limb_pairs layout).
+
+    The packed+sliced single-device round 3: the 25 coset-eval polynomials
+    stay resident packed (half the bytes), and each slice unpacks only its
+    own window in-kernel — together these halve the ~7 GB round-3 working
+    set that OOM'd n=2^19 on one chip (scale_2p19_r04.log; reference
+    quotient loop: /root/reference/src/dispatcher2.rs:434-507). j0 is a
+    TRACED lane offset so every slice reuses one compiled program."""
+    def cut(a):
+        return lax.dynamic_slice_in_dim(a, j0, chunk, axis=a.ndim - 1)
+
+    unp = FJ.unpack_limb_pairs
+    sel = jnp.stack([unp(cut(s)) for s in sel_p], axis=1)
+    sig = jnp.stack([unp(cut(s)) for s in sig_p], axis=1)
+    wir = jnp.stack([unp(cut(s)) for s in wir_p], axis=1)
+    return quotient_evals_core(
+        sel, sig, wir, unp(cut(z_p)), unp(cut(z_next_p)), unp(cut(pi_p)),
+        unp(cut(ep_p)), unp(cut(zh_inv_p)), unp(cut(shifted_inv_p)),
+        k, beta, gamma, alpha, alpha_sq_div_n)
 
 
 # --- polynomial utility kernels ---------------------------------------------
@@ -308,5 +345,8 @@ synthetic_divide_jit = jax.jit(synthetic_divide)
 lin_comb_jit = jax.jit(lin_comb)
 blind_jit = jax.jit(add_vanishing_blind, static_argnums=2)
 quotient_evals_jit = jax.jit(quotient_evals, static_argnums=11)
+quotient_slice_jit = jax.jit(quotient_slice, static_argnames=("chunk",))
 domain_tables_jit = jax.jit(domain_tables, static_argnums=(0, 1, 2, 3))
+pack_jit = jax.jit(FJ.pack_limb_pairs)
+roll_jit = jax.jit(lambda v, r: jnp.roll(v, -r, axis=1), static_argnums=1)
 perm_product_jit = jax.jit(perm_product)

