@@ -200,22 +200,30 @@ class JaxBackend:
         return (jnp.pad(h, ((0, 0), (0, size - h.shape[1])))
                 if h.shape[1] < size else h)
 
-    def _kernel_many(self, domain, hs, inverse, coset, post=None):
-        """B NTTs in capped batches; `post` (if given) maps each launch's
-        (16, B, m) result before results are split out — e.g. the round-3
-        limb packing, applied while at most one batch is unpacked."""
+    def _kernel_batches(self, domain, hs, inverse, coset):
+        """Yield (16, B, m) NTT result batches covering hs in order, B
+        capped by the launch budget. The ONE copy of the cap/chunk/pad
+        logic — _kernel_many collects, quotient_streamed folds each batch
+        into accumulators so no batch outlives its consumption."""
         plan = ntt_jax.get_plan(domain.size)
         elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
         chunk = max(1, min(self._NTT_BATCH, elems_cap // domain.size))
         if chunk == 1:
             fn1 = plan.kernel(inverse=inverse, coset=coset, boundary="mont")
-            one = ((lambda h: post(fn1(h))) if post else fn1)
-            return [one(self._pad_to(h, domain.size)) for h in hs]
+            for h in hs:
+                yield fn1(self._pad_to(h, domain.size))[:, None]
+            return
         fn = plan.kernel_batch(inverse=inverse, coset=coset)
-        out = []
         for i in range(0, len(hs), chunk):
-            res = fn(jnp.stack([self._pad_to(h, domain.size)
+            yield fn(jnp.stack([self._pad_to(h, domain.size)
                                 for h in hs[i:i + chunk]], axis=1))
+
+    def _kernel_many(self, domain, hs, inverse, coset, post=None):
+        """B NTTs in capped batches; `post` (if given) maps each launch's
+        (16, B, m) result before results are split out — e.g. the round-3
+        limb packing, applied while at most one batch is unpacked."""
+        out = []
+        for res in self._kernel_batches(domain, hs, inverse, coset):
             if post is not None:
                 res = post(res)
             out.extend(res[:, j] for j in range(res.shape[1]))
@@ -227,18 +235,20 @@ class JaxBackend:
     def coset_fft_many(self, domain, hs):
         return self._kernel_many(domain, hs, False, True)
 
-    # --- packed round 3 ------------------------------------------------------
-    # The single-device memory strategy for the quotient round: coset evals
-    # live LIMB-PACKED (8, m) — two 16-bit limbs per u32 — and the quotient
-    # evaluation runs in lane slices that unpack on the fly. Together these
-    # halve the ~7 GB coset-eval residency that OOM'd n=2^19 on one chip
-    # (scale_2p19_r04.log; the working set is inherent to the reference's
-    # round-3 quotient pipeline, /root/reference/src/dispatcher2.rs:382-507).
-    # The mesh backend opts out (packed_round3 = False): there the memory
-    # strategy is sharding, and slicing a GSPMD-sharded lane axis would
-    # reshard every chunk.
+    # --- streaming round 3 ---------------------------------------------------
+    # The single-device memory strategy for the quotient round
+    # (/root/reference/src/dispatcher2.rs:382-507): the quotient formula
+    # reads each SELECTOR plane once (a gate term) and each SIGMA plane
+    # once (an acc2 factor), so both fold into running accumulators right
+    # after their coset FFT and are dropped. Only ~10 planes stay
+    # resident — 5 wires, z, z_next/acc2, pi→gate — all LIMB-PACKED
+    # (8, m), and the final combine runs in lane slices that unpack on
+    # the fly. Residency: ~2.5 GB at m=2^23 vs 6.4 GB all-packed and
+    # 12.8 GB naive — the measured single-chip budget is ~7-9.5 GB
+    # (scale_2p19_r05 attempt logs). The mesh backend opts out
+    # (quotient_streamed = None): its memory strategy is sharding, and
+    # slicing a GSPMD-sharded lane axis would reshard every chunk.
 
-    packed_round3 = True
     _QUOT_SLICE = int(os.environ.get("DPT_QUOT_SLICE", str(1 << 20)))
 
     def coset_fft_many_packed(self, domain, hs):
@@ -258,15 +268,49 @@ class JaxBackend:
                 self._domain_tabs_packed[key] = hit
         return hit
 
-    def quotient_packed(self, n, m, quot_domain, k, beta, gamma, alpha,
-                        alpha_sq_div_n, sel_p, sig_p, wir_p, z_p, pi_p):
-        """Quotient evaluations from packed (8, m) coset planes, computed
-        in DPT_QUOT_SLICE-lane slices through ONE compiled program (the
-        slice offset is a traced scalar). Returns unpacked (16, m) evals
-        for the coset iFFT."""
+    def quotient_streamed(self, n, m, quot_domain, k, beta, gamma, alpha,
+                          alpha_sq_div_n, sel_h, sigma_h, wire_polys,
+                          perm_poly, pi_coeffs):
+        """Round 3 from coefficient handles: coset FFTs + quotient
+        evaluation in one streaming pass (see class comment). Returns
+        unpacked (16, m) quotient evals for the coset iFFT."""
         tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
         ratio = m // n
-        z_next_p = PJ.roll_jit(z_p, ratio)
+        base = self.coset_fft_many_packed(
+            quot_domain, list(wire_polys) + [perm_poly, pi_coeffs])
+        wires_p = base[:5]
+        z_p = base[5]
+        gate_p = base[6]               # gate accumulator starts as pi plane
+        acc2_p = PJ.roll_jit(z_p, ratio)  # acc2 starts as z_next
+        del base
+
+        beta_c = jnp.asarray(PJ.lift_scalar(beta))
+        gamma_c = jnp.asarray(PJ.lift_scalar(gamma))
+        w = wires_p
+        # selector index -> (structural step program, wire-plane operands);
+        # 13 selectors share 6 compiled programs (circuit.py order)
+        gate_steps = (
+            [(PJ.gate_linear_step_jit, (w[i],)) for i in range(4)]      # Q_LC
+            + [(PJ.gate_mul2_step_jit, (w[0], w[1])),                   # Q_MUL
+               (PJ.gate_mul2_step_jit, (w[2], w[3]))]
+            + [(PJ.gate_pow5_step_jit, (w[i],)) for i in range(4)]      # Q_HASH
+            + [(PJ.gate_out_step_jit, (w[4],)),                         # Q_O
+               (PJ.gate_const_step_jit, ()),                            # Q_C
+               (PJ.gate_ecc_step_jit, tuple(w))]                        # Q_ECC
+        )
+        idx = 0
+        for res in self._kernel_batches(quot_domain, list(sel_h), False, True):
+            for j in range(res.shape[1]):
+                fn, operands = gate_steps[idx]
+                gate_p = fn(gate_p, res[:, j], *operands)
+                idx += 1
+        sj = 0
+        for res in self._kernel_batches(quot_domain, list(sigma_h), False, True):
+            for j in range(res.shape[1]):
+                acc2_p = PJ.sigma_step_jit(acc2_p, res[:, j], w[sj],
+                                           beta_c, gamma_c)
+                sj += 1
+
         chunk = min(self._QUOT_SLICE, m)
         assert m % chunk == 0
         k_arr = jnp.asarray(PJ.lift(list(k))).reshape(FR_LIMBS, len(k), 1)
@@ -274,8 +318,8 @@ class JaxBackend:
                 for x in (beta, gamma, alpha, alpha_sq_div_n)]
         outs = []
         for j0 in range(0, m, chunk):
-            outs.append(PJ.quotient_slice_jit(
-                list(sel_p), list(sig_p), list(wir_p), z_p, z_next_p, pi_p,
+            outs.append(PJ.quotient_combine_slice_jit(
+                list(wires_p), z_p, gate_p, acc2_p,
                 tabs["ep"], tabs["zh_inv"], tabs["shifted_inv"],
                 k_arr, *scal, np.uint32(j0), chunk=chunk))
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)

@@ -211,29 +211,107 @@ def quotient_evals(selectors, sigmas, wires, z, pi, tabs, k, beta, gamma,
         tabs["shifted_inv"], k, beta, gamma, alpha, alpha_sq_div_n)
 
 
-def quotient_slice(sel_p, sig_p, wir_p, z_p, z_next_p, pi_p, ep_p, zh_inv_p,
-                   shifted_inv_p, k, beta, gamma, alpha, alpha_sq_div_n, j0,
-                   *, chunk):
-    """One `chunk`-wide slice of the quotient evaluation from LIMB-PACKED
-    (8, m) inputs (field_jax.pack_limb_pairs layout).
+# --- streaming round 3: consume each selector/sigma plane as it is made ------
+# The residency floor of the packed path is still all 25 coset planes at
+# once (6.4 GB packed at m=2^23 — past the measured single-chip budget).
+# But the quotient formula reads each SELECTOR plane exactly once (one
+# gate term) and each SIGMA plane exactly once (one acc2 factor), so both
+# can be folded into running accumulators right after their coset FFT and
+# dropped. Only 10 planes ever stay resident: 5 wires, z, z_next, pi→gate,
+# acc2 — ~2.5 GB packed at m=2^23, unlocking the n=2^20 prove.
+# (Reference formula: /root/reference/src/dispatcher2.rs:434-507.)
 
-    The packed+sliced single-device round 3: the 25 coset-eval polynomials
-    stay resident packed (half the bytes), and each slice unpacks only its
-    own window in-kernel — together these halve the ~7 GB round-3 working
-    set that OOM'd n=2^19 on one chip (scale_2p19_r04.log; reference
-    quotient loop: /root/reference/src/dispatcher2.rs:434-507). j0 is a
-    TRACED lane offset so every slice reuses one compiled program."""
+# Gate accumulation steps, one jitted program per operand STRUCTURE (the
+# wire plane(s) a selector multiplies are passed as arguments, so the 13
+# selectors reuse 6 compiled programs instead of 13 — each compile is at
+# full quotient-domain width and goes through the remote relay, so the
+# program count is cold-prove wall-clock). gate_p is the packed (8, m)
+# accumulator (initialized to the pi plane); plane is the UNPACKED
+# (16, m) selector coset evals straight from the FFT launch. Selector
+# order: circuit.py (Q_LC x4, Q_MUL x2, Q_HASH x4, Q_O, Q_C, Q_ECC).
+
+def _gate_add(gate_p, term):
+    return FJ.pack_limb_pairs(
+        FJ.add(FR, FJ.unpack_limb_pairs(gate_p), term))
+
+
+def gate_linear_step(gate_p, plane, w_p):
+    """gate += sel * w (the four Q_LC selectors)."""
+    return _gate_add(gate_p, _mm(plane, FJ.unpack_limb_pairs(w_p)))
+
+
+def gate_mul2_step(gate_p, plane, wa_p, wb_p):
+    """gate += sel * (wa * wb) (the two Q_MUL selectors)."""
+    unp = FJ.unpack_limb_pairs
+    return _gate_add(gate_p, _mm(plane, _mm(unp(wa_p), unp(wb_p))))
+
+
+def gate_pow5_step(gate_p, plane, w_p):
+    """gate += sel * w^5 (the four Q_HASH selectors)."""
+    return _gate_add(gate_p, _mm(plane, _pow5(FJ.unpack_limb_pairs(w_p))))
+
+
+def gate_out_step(gate_p, plane, w_p):
+    """gate -= sel * e (Q_O)."""
+    return FJ.pack_limb_pairs(
+        FJ.sub(FR, FJ.unpack_limb_pairs(gate_p),
+               _mm(plane, FJ.unpack_limb_pairs(w_p))))
+
+
+def gate_const_step(gate_p, plane):
+    """gate += sel (Q_C)."""
+    return _gate_add(gate_p, plane)
+
+
+def gate_ecc_step(gate_p, plane, w0_p, w1_p, w2_p, w3_p, w4_p):
+    """gate += sel * a*b*c*d*e (Q_ECC)."""
+    unp = FJ.unpack_limb_pairs
+    abcd = _mm(_mm(unp(w0_p), unp(w1_p)), _mm(unp(w2_p), unp(w3_p)))
+    return _gate_add(gate_p, _mm(plane, _mm(abcd, unp(w4_p))))
+
+
+def sigma_step(acc2_p, plane, w_p, beta, gamma):
+    """acc2 *= (w + gamma + beta * sigma) — ONE program for all 5 sigmas.
+
+    acc2 is INITIALIZED to the rolled z plane (z_next), so after the 5
+    sigma steps it equals quotient_evals_core's full acc2 product."""
+    unp = FJ.unpack_limb_pairs
+    acc2 = unp(acc2_p)
+    wj = unp(w_p)
+    t = FJ.add(FR, wj, jnp.broadcast_to(gamma, wj.shape))
+    f = FJ.add(FR, t, _mm(plane, jnp.broadcast_to(beta, plane.shape)))
+    return FJ.pack_limb_pairs(_mm(acc2, f))
+
+
+def quotient_combine_slice(wires_p, z_p, gate_p, acc2_p, ep_p,
+                           zh_inv_p, shifted_inv_p, k, beta, gamma, alpha,
+                           alpha_sq_div_n, j0, *, chunk):
+    """Final combine on one lane slice: acc1 from the resident wires + ep
+    table, then out = zh_inv*(gate + alpha*(acc1 - acc2)) + l1. Inputs
+    packed (acc2 already includes the z_next factor); j0 traced so all
+    slices share one program."""
     def cut(a):
         return lax.dynamic_slice_in_dim(a, j0, chunk, axis=a.ndim - 1)
 
     unp = FJ.unpack_limb_pairs
-    sel = jnp.stack([unp(cut(s)) for s in sel_p], axis=1)
-    sig = jnp.stack([unp(cut(s)) for s in sig_p], axis=1)
-    wir = jnp.stack([unp(cut(s)) for s in wir_p], axis=1)
-    return quotient_evals_core(
-        sel, sig, wir, unp(cut(z_p)), unp(cut(z_next_p)), unp(cut(pi_p)),
-        unp(cut(ep_p)), unp(cut(zh_inv_p)), unp(cut(shifted_inv_p)),
-        k, beta, gamma, alpha, alpha_sq_div_n)
+    z = unp(cut(z_p))
+    gate = unp(cut(gate_p))
+    acc2 = unp(cut(acc2_p))
+    ep = unp(cut(ep_p))
+    sh = unp(cut(shifted_inv_p))
+    zh = unp(cut(zh_inv_p))
+    shape = z.shape
+    beta_b = jnp.broadcast_to(beta, shape)
+    acc1 = z
+    for j in range(5):
+        wj = unp(cut(wires_p[j]))
+        t = FJ.add(FR, wj, jnp.broadcast_to(gamma, shape))
+        kj = jnp.broadcast_to(k[:, j], shape)
+        acc1 = _mm(acc1, FJ.add(FR, t, _mm(_mm(kj, ep), beta_b)))
+    perm = _mm(jnp.broadcast_to(alpha, shape), FJ.sub(FR, acc1, acc2))
+    l1 = _mm(_mm(jnp.broadcast_to(alpha_sq_div_n, shape),
+                 FJ.sub(FR, z, _one_like(z))), sh)
+    return FJ.add(FR, _mm(zh, FJ.add(FR, gate, perm)), l1)
 
 
 # --- polynomial utility kernels ---------------------------------------------
@@ -345,7 +423,15 @@ synthetic_divide_jit = jax.jit(synthetic_divide)
 lin_comb_jit = jax.jit(lin_comb)
 blind_jit = jax.jit(add_vanishing_blind, static_argnums=2)
 quotient_evals_jit = jax.jit(quotient_evals, static_argnums=11)
-quotient_slice_jit = jax.jit(quotient_slice, static_argnames=("chunk",))
+gate_linear_step_jit = jax.jit(gate_linear_step)
+gate_mul2_step_jit = jax.jit(gate_mul2_step)
+gate_pow5_step_jit = jax.jit(gate_pow5_step)
+gate_out_step_jit = jax.jit(gate_out_step)
+gate_const_step_jit = jax.jit(gate_const_step)
+gate_ecc_step_jit = jax.jit(gate_ecc_step)
+sigma_step_jit = jax.jit(sigma_step)
+quotient_combine_slice_jit = jax.jit(quotient_combine_slice,
+                                     static_argnames=("chunk",))
 domain_tables_jit = jax.jit(domain_tables, static_argnums=(0, 1, 2, 3))
 pack_jit = jax.jit(FJ.pack_limb_pairs)
 roll_jit = jax.jit(lambda v, r: jnp.roll(v, -r, axis=1), static_argnums=1)

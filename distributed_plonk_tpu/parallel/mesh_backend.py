@@ -58,9 +58,9 @@ class MeshBackend(JaxBackend):
     """Backend whose poly handles are mesh-sharded device arrays."""
 
     name = "mesh"
-    # memory strategy here is sharding, not packing: slicing a
+    # memory strategy here is sharding, not streaming+packing: slicing a
     # GSPMD-sharded lane axis per quotient chunk would reshard every slice
-    packed_round3 = False
+    quotient_streamed = None
 
     # minimum per-device coefficient count for sharding a handle: below
     # this, elementwise/scan round math runs REPLICATED on the mesh

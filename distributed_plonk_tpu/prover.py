@@ -101,38 +101,47 @@ def prove(rng, circuit, pk, backend, tracer=None):
     alpha = transcript.get_and_append_challenge(b"alpha")
     alpha_sq_div_n = alpha * alpha % R_MOD * fr_inv(n % R_MOD) % R_MOD
 
-    # packed_round3: single-device backends keep the 25 coset-eval
-    # polynomials limb-packed and evaluate the quotient in lane slices
-    # (halves the round-3 residency that OOM'd n=2^19 on one chip); the
-    # host oracle and the mesh backend (whose memory strategy is sharding)
-    # run the one-shot unpacked path. Both compute identical values.
-    packed = getattr(backend, "packed_round3", False)
+    # quotient_streamed: single-device backends fold each selector/sigma
+    # coset plane into running accumulators as it is produced, so only
+    # ~10 limb-packed planes are ever resident (the round-3 working set
+    # was the single-chip scale ceiling); the host oracle and the mesh
+    # backend (whose memory strategy is sharding) run the one-shot
+    # unpacked path. Both compute identical values.
+    stream = getattr(backend, "quotient_streamed", None)
     with tr.span("round3"):
-        with tr.span("coset_ffts", polys=len(sel_h) + 2 * num_wire_types + 2):
-            # the 24 coset-FFTs go out as one batch (concurrent across the
-            # fleet / one device launch; reference dispatcher2.rs:382-423)
-            pi_coeffs = backend.ifft_h(
-                domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
-            coset_in = (list(sel_h) + list(sigma_h) + wire_polys
-                        + [permutation_poly, pi_coeffs])
-            batch = (backend.coset_fft_many_packed(quot_domain, coset_in)
-                     if packed else
-                     backend.coset_fft_many(quot_domain, coset_in))
-            ns, nw = len(sel_h), num_wire_types
-            selectors_coset = batch[:ns]
-            sigmas_coset = batch[ns:ns + nw]
-            wires_coset = batch[ns + nw:ns + 2 * nw]
-            z_coset = batch[ns + 2 * nw]
-            pi_coset = batch[ns + 2 * nw + 1]
+        pi_coeffs = backend.ifft_h(
+            domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
+        if stream is not None:
+            with tr.span("quotient_stream", m=m,
+                         polys=len(sel_h) + 2 * num_wire_types + 2):
+                quot_evals = stream(
+                    n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
+                    alpha_sq_div_n, sel_h, sigma_h, wire_polys,
+                    permutation_poly, pi_coeffs)
+        else:
+            with tr.span("coset_ffts",
+                         polys=len(sel_h) + 2 * num_wire_types + 2):
+                # the 24 coset-FFTs go out as one batch (concurrent across
+                # the fleet / one device launch; dispatcher2.rs:382-423)
+                batch = backend.coset_fft_many(
+                    quot_domain,
+                    list(sel_h) + list(sigma_h) + wire_polys
+                    + [permutation_poly, pi_coeffs])
+                ns, nw = len(sel_h), num_wire_types
+                selectors_coset = batch[:ns]
+                sigmas_coset = batch[ns:ns + nw]
+                wires_coset = batch[ns + nw:ns + 2 * nw]
+                z_coset = batch[ns + 2 * nw]
+                pi_coset = batch[ns + 2 * nw + 1]
 
-        with tr.span("quotient_evals", m=m):
-            quot_fn = backend.quotient_packed if packed else backend.quotient
-            quot_evals = quot_fn(
-                n, m, quot_domain, pk.vk.k, beta, gamma, alpha, alpha_sq_div_n,
-                selectors_coset, sigmas_coset, wires_coset, z_coset, pi_coset,
-            )
-            del batch, selectors_coset, sigmas_coset, wires_coset
-            del z_coset, pi_coset
+            with tr.span("quotient_evals", m=m):
+                quot_evals = backend.quotient(
+                    n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
+                    alpha_sq_div_n, selectors_coset, sigmas_coset,
+                    wires_coset, z_coset, pi_coset,
+                )
+                del batch, selectors_coset, sigmas_coset, wires_coset
+                del z_coset, pi_coset
         with tr.span("coset_ifft_quot"):
             quotient_poly = backend.coset_ifft_h(quot_domain, quot_evals)
 

@@ -33,26 +33,29 @@ def test_pack_unpack_roundtrip():
     assert np.array_equal(np.asarray(FJ.unpack_limb_pairs(p)), np.asarray(v))
 
 
-def test_quotient_packed_matches_unpacked_multislice():
+def test_quotient_streamed_matches_unpacked_multislice():
+    """The streaming round 3 (accumulating gate/acc2 plane by plane,
+    sliced final combine) must be VALUE-IDENTICAL to the one-shot
+    unpacked path from the same coefficient handles."""
     n, m = 64, 512
     qd = Domain(m)
     be = JaxBackend()
-    be._QUOT_SLICE = 128  # force 4 slices through one compiled program
+    be._QUOT_SLICE = 128  # force 4 combine slices through one program
 
-    sel = [_rand_h(m) for _ in range(13)]
-    sig = [_rand_h(m) for _ in range(5)]
-    wir = [_rand_h(m) for _ in range(5)]
-    z, pi = _rand_h(m), _rand_h(m)
+    sel = [_rand_h(n) for _ in range(13)]
+    sig = [_rand_h(n) for _ in range(5)]
+    wir = [_rand_h(n + 2) for _ in range(5)]  # blinded wire lengths
+    zpoly = _rand_h(n + 3)
+    pi = _rand_h(n)
     k = [RNG.randrange(R_MOD) for _ in range(5)]
     beta, gamma, alpha, asdn = (RNG.randrange(R_MOD) for _ in range(4))
 
+    batch = be.coset_fft_many(qd, sel + sig + wir + [zpoly, pi])
     ref = be.quotient(n, m, qd, k, beta, gamma, alpha, asdn,
-                      sel, sig, wir, z, pi)
-    got = be.quotient_packed(n, m, qd, k, beta, gamma, alpha, asdn,
-                             [PJ.pack_jit(s) for s in sel],
-                             [PJ.pack_jit(s) for s in sig],
-                             [PJ.pack_jit(s) for s in wir],
-                             PJ.pack_jit(z), PJ.pack_jit(pi))
+                      batch[:13], batch[13:18], batch[18:23],
+                      batch[23], batch[24])
+    got = be.quotient_streamed(n, m, qd, k, beta, gamma, alpha, asdn,
+                               sel, sig, wir, zpoly, pi)
     assert np.array_equal(np.asarray(ref), np.asarray(got))
 
 
