@@ -54,6 +54,28 @@ def ntt_mesh_plan(n, n_devices, batch=1):
     }
 
 
+def round3_mesh_plan(n, m, n_devices):
+    """Per-device RESIDENT byte budget at the mesh quotient evaluation
+    (the round-3 peak): the 25 coset planes (13 selectors + 5 sigmas +
+    5 wires + z + pi), their stacked copies inside the one-shot quotient
+    kernel (jnp.stack makes (16, k, m) copies of sel/sig/wires), and the
+    3 domain tables — all lane-sharded m/D wide. This is the figure
+    scripts/mesh_prove_scale.py checks against live per-device buffer
+    stats, validating the 2^21+ plan by execution (reference analog of
+    the O(N/P) worker footprint, /root/reference/src/worker.rs:223-227)."""
+    local = m // n_devices
+    planes = 25 * FR_BYTES_DEVICE * local
+    stacks = 23 * FR_BYTES_DEVICE * local  # sel(13)+sig(5)+wires(5) stacked
+    tables = 3 * FR_BYTES_DEVICE * local   # ep, zh_inv, shifted_inv
+    # n-scale state (pk polys, wire polys) is m/8-scale — small but real
+    base = 28 * FR_BYTES_DEVICE * (n // n_devices)
+    return {
+        "local_elems": local, "planes": planes, "stacks": stacks,
+        "tables": tables, "base": base,
+        "resident": planes + stacks + tables + base,
+    }
+
+
 def msm_mesh_plan(n, n_devices, batch=1, c_bits=8, signed=True,
                   group=512):
     """Byte budget for a batch-B mesh MSM of n points over n_devices."""
