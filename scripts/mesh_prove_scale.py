@@ -101,7 +101,8 @@ def main():
           f"rounds={res['rounds']}", file=sys.stderr)
 
     if snap:
-        m = Domain((5 + 1) * (ckt.n + 1) + 1).size
+        from distributed_plonk_tpu.circuit import NUM_WIRE_TYPES
+        m = Domain((NUM_WIRE_TYPES + 1) * (ckt.n + 1) + 1).size  # prover.py:53
         plan = memory_plan.round3_mesh_plan(ckt.n, m, args.devices)
         actual = snap["per_device"]
         worst = max(actual.values()) if actual else 0
@@ -122,6 +123,11 @@ def main():
               f"{plan['resident'] / 2**20:.1f} MiB "
               f"(within={res['residency']['actual_within_plan']})",
               file=sys.stderr)
+        # the plan is only "validated by execution" if violations FAIL
+        assert res["residency"]["actual_within_plan"], (
+            f"per-device residency {worst} exceeds the round-3 plan "
+            f"{plan['resident']} (x1.5 + 64 MiB slack) — update "
+            f"memory_plan.round3_mesh_plan to match the real working set")
 
     ok = verify(vk, ckt.public_input(), proof, rng=random.Random(14))
     res["verified"] = bool(ok)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""A/B the Pallas multiplier on the chip: lane-tile width x lazy-carry.
+
+Both knobs are import-time constants (DPT_PALLAS_LANE_TILE, DPT_MUL_LAZY),
+so each configuration runs in a fresh subprocess. Measures wide Fr/Fq
+mont_mul ns/lane (the rate every NTT stage and MSM add inherits) and
+checks 1024 lanes against the host oracle in every configuration.
+
+Usage: python scripts/mul_tile_ab.py [--out FILE]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+INNER = r"""
+import json, os, random, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax
+import jax.numpy as jnp
+from distributed_plonk_tpu.constants import R_MOD, Q_MOD, FR_MONT_R, FQ_MONT_R
+from distributed_plonk_tpu.backend import field_jax as FJ
+from distributed_plonk_tpu.backend.limbs import ints_to_limbs, limbs_to_ints
+
+def sync(x):
+    np.asarray(x[:, :1])
+
+out = {"tile": int(os.environ["DPT_PALLAS_LANE_TILE"]),
+       "lazy": os.environ.get("DPT_MUL_LAZY", "0") != "0"}
+rng_np = np.random.default_rng(7)
+rng = random.Random(9)
+for spec, lanes, mod, mont_r, name in (
+        (FJ.FR, 1 << 21, R_MOD, FR_MONT_R, "fr"),
+        (FJ.FQ, 1 << 20, Q_MOD, FQ_MONT_R, "fq")):
+    L = spec.n_limbs
+    a = jnp.asarray(rng_np.integers(0, 1 << 16, (L, lanes), dtype=np.uint32))
+    mul = jax.jit(lambda u, v, s=spec: FJ.mont_mul(s, u, v))
+    sync(mul(a, a))
+    reps = 4
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        o = mul(a, a)
+    sync(o)
+    dt = (time.perf_counter() - t0) / reps
+    out[f"{name}_ns_per_mul"] = round(dt / lanes * 1e9, 2)
+    # oracle check on 1024 lanes through the same dispatch
+    xs = [rng.randrange(mod) for _ in range(1024)]
+    ys = [rng.randrange(mod) for _ in range(1024)]
+    got = limbs_to_ints(np.asarray(
+        mul(jnp.asarray(ints_to_limbs(xs, L)),
+            jnp.asarray(ints_to_limbs(ys, L)))))
+    r_inv = pow(mont_r, mod - 2, mod)
+    assert got == [x * y %% mod * r_inv %% mod for x, y in zip(xs, ys)], \
+        "ORACLE MISMATCH"
+    out[f"{name}_oracle_ok"] = True
+print("RESULT " + json.dumps(out))
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--tiles", default="512,1024,2048")
+    ap.add_argument("--timeout", type=int, default=1200)
+    args = ap.parse_args()
+
+    results = []
+    for lazy in ("0", "1"):
+        for tile in args.tiles.split(","):
+            env = dict(os.environ,
+                       DPT_PALLAS_LANE_TILE=tile,
+                       DPT_MUL_LAZY=lazy,
+                       DPT_FIELD_MUL="pallas")
+            print(f"[ab] tile={tile} lazy={lazy} ...", file=sys.stderr)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", INNER % {"repo": REPO}],
+                    env=env, capture_output=True, text=True,
+                    timeout=args.timeout)
+            except subprocess.TimeoutExpired:
+                results.append({"tile": int(tile), "lazy": lazy == "1",
+                                "error": "timeout"})
+                continue
+            line = next((l for l in proc.stdout.splitlines()
+                         if l.startswith("RESULT ")), None)
+            if line:
+                results.append(json.loads(line[len("RESULT "):]))
+                print(f"[ab]   -> {line[len('RESULT '):]}", file=sys.stderr)
+            else:
+                results.append({"tile": int(tile), "lazy": lazy == "1",
+                                "error": (proc.stderr or "")[-500:]})
+                print(f"[ab]   FAILED rc={proc.returncode}", file=sys.stderr)
+    blob = json.dumps({"configs": results})
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob + "\n")
+    print(blob)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,29 @@ def test_mont_mul_fq_matches_oracle():
     _check(FQ, Q_MOD, FQ_MONT_R, 64)
 
 
+@pytest.mark.parametrize("spec_key,mod,mont_r", [
+    ("fr", R_MOD, FR_MONT_R), ("fq", Q_MOD, FQ_MONT_R)])
+def test_mont_mul_lazy_bit_identical(spec_key, mod, mont_r):
+    """The lazy-carry kernel (semi-normalized digit columns, 3 exact
+    sweeps instead of 5) must be BIT-identical to the strict kernel and
+    the host oracle — its m' representative differs mid-kernel but the
+    final conditional subtract lands on the canonical value."""
+    spec = FR if spec_key == "fr" else FQ
+    n = FP.LANE_TILE  # exactly one grid step
+    xs = [RNG.randrange(mod) for _ in range(n)]
+    ys = [RNG.randrange(mod) for _ in range(n)]
+    xs[:4] = [0, 1, mod - 1, mod - 2]
+    ys[:4] = [mod - 1, 0, mod - 1, mod - 2]
+    a = ints_to_limbs(xs, spec.n_limbs)
+    b = ints_to_limbs(ys, spec.n_limbs)
+    strict = np.asarray(FP._mont_mul_flat(spec_key, True, False, a, b))
+    lazy = np.asarray(FP._mont_mul_flat(spec_key, True, True, a, b))
+    assert np.array_equal(strict, lazy)
+    r_inv = pow(mont_r, mod - 2, mod)
+    assert limbs_to_ints(lazy) == [
+        x * y % mod * r_inv % mod for x, y in zip(xs, ys)]
+
+
 def test_broadcast_and_batch_shapes():
     n = 8
     xs = [RNG.randrange(R_MOD) for _ in range(n)]
