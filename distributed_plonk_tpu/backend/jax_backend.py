@@ -419,13 +419,26 @@ class JaxBackend:
         hook, like _lift_arr)."""
         return jnp.asarray(arr).reshape(FR_LIMBS, w, n)
 
+    # below this n the circuit tables stay cached across proves: the
+    # release exists for round-3 HBM headroom at 2^19+, while re-lifting
+    # the ~3*(16,5,n) tables through the tunnel costs real wall-clock
+    # (measured +8.6s on the 2^18 warm prove, scale_2p18_r05.json r1)
+    _RELEASE_TABLES_MIN = int(os.environ.get("DPT_RELEASE_TABLES_MIN",
+                                             str(1 << 19)))
+
     def release_circuit_tables(self, circuit):
-        """Free the witness/permutation device tables (≈0.5 GB at n=2^19).
+        """Free the witness/permutation device tables (≈0.5 GB at n=2^19)
+        when the circuit is large enough that round 3 needs the HBM.
 
         The prover calls this after round 2 — wire_values (round 1) and
-        perm_product (round 2) are the only consumers — so the HBM is
-        available to round 3's coset planes. A subsequent prove of the
-        same circuit re-lifts them (one O(n) upload)."""
+        perm_product (round 2) are the only consumers. Above the
+        threshold a subsequent prove re-lifts them (one O(n) upload);
+        below it they stay cached keyed by circuit IDENTITY (the
+        long-standing _circuit_tabs contract: mutating a circuit's
+        witness in place and re-proving the same object is not
+        supported — build a new circuit)."""
+        if len(circuit.wire_variables[0]) < self._RELEASE_TABLES_MIN:
+            return
         with self._cache_lock:
             self._circuit_tabs.pop(id(circuit), None)
 

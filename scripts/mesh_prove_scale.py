@@ -123,11 +123,6 @@ def main():
               f"{plan['resident'] / 2**20:.1f} MiB "
               f"(within={res['residency']['actual_within_plan']})",
               file=sys.stderr)
-        # the plan is only "validated by execution" if violations FAIL
-        assert res["residency"]["actual_within_plan"], (
-            f"per-device residency {worst} exceeds the round-3 plan "
-            f"{plan['resident']} (x1.5 + 64 MiB slack) — update "
-            f"memory_plan.round3_mesh_plan to match the real working set")
 
     ok = verify(vk, ckt.public_input(), proof, rng=random.Random(14))
     res["verified"] = bool(ok)
@@ -151,6 +146,16 @@ def main():
         with open(args.out, "w") as f:
             f.write(line + "\n")
     print(line)
+
+    # fail LOUDLY on a residency-plan violation — but only after the
+    # measurements (multi-minute on the virtual mesh) are safely written
+    if "residency" in res and not res["residency"]["actual_within_plan"]:
+        raise SystemExit(
+            f"per-device residency {res['residency']['actual_max_per_device']}"
+            f" exceeds the round-3 plan "
+            f"{res['residency']['plan_resident_per_device']} (x1.5 + 64 MiB "
+            f"slack) — update memory_plan.round3_mesh_plan to the real "
+            f"working set")
 
 
 if __name__ == "__main__":
