@@ -183,7 +183,7 @@ def _group_size_batch(n, batch, c, signed=False):
     """Group width for a B-poly batched MSM: work-optimal size per
     _group_size, further capped so the plane array (which scales with
     group * B * W * buckets) stays in budget."""
-    w = SCALAR_BITS // c
+    w = -(-SCALAR_BITS // c)  # ceil: c=7 has 37 windows, not 36
     buckets = 1 << (c - 1) if signed else 1 << c
     per_group = 3 * 4 * FQ_LIMBS * batch * w * buckets
     g = _group_size(n)
@@ -253,25 +253,25 @@ def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
     return _plane_finish(planes)
 
 
-def _bucket_scan_signed(ax, ay, ainf, packed, group):
-    """SIGNED-digit COMBINED-LANE bucket accumulation — the c=8 hot path:
-    half the buckets of the unsigned scan (128 columns, bucket i holds
-    points whose |digit| == i+1; the sign is applied to the point's y on
-    the fly), the accumulator add is RCB15's complete formula (11 muls in
-    2 stacked-lane instances, no doubling fallback, no edge selects), and
-    every scan step is ONE wide gather/add/scatter across all M lanes
-    (see _bucket_scan for why).
+def _bucket_scan_signed(ax, ay, ainf, packed, group, n_buckets=128):
+    """SIGNED-digit COMBINED-LANE bucket accumulation — the signed hot
+    path (c=8: 128 bucket columns; c=7: 64): half the buckets of the
+    unsigned scan (bucket i holds points whose |digit| == i+1; the sign
+    is applied to the point's y on the fly), the accumulator add is
+    RCB15's complete formula (11 muls in 2 stacked-lane instances, no
+    doubling fallback, no edge selects), and every scan step is ONE wide
+    gather/add/scatter across all M lanes (see _bucket_scan for why).
 
     ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (M, n)
-    uint32 = digit + 128 with digit in [-128, 127]. Returns
-    ((24, group, M, 128),)*3 PROJECTIVE bucket planes.
+    uint32 = digit + n_buckets with digit in [-n_buckets, n_buckets-1].
+    Returns ((24, group, M, n_buckets),)*3 PROJECTIVE bucket planes.
     """
     M = packed.shape[0]
-    off = packed.astype(jnp.int32) - 128
+    off = packed.astype(jnp.int32) - n_buckets
     neg = off < 0
     mag = jnp.abs(off)
     skip = (mag == 0) | ainf[None, :]
-    idx = jnp.maximum(mag, 1).astype(jnp.uint32) - 1  # 0..127
+    idx = jnp.maximum(mag, 1).astype(jnp.uint32) - 1  # 0..n_buckets-1
 
     sx_all, sy_all = _scan_layout(ax, ay, group)
     xs = (sx_all, sy_all, _to_scan_m(skip, group), _to_scan_m(neg, group),
@@ -279,7 +279,7 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group):
 
     vz = ax.ravel()[0] & 0  # varying-zero, see _bucket_scan
     init = _plane_init(tuple(
-        b + vz for b in CJ.proj_inf((group, M, 128))))
+        b + vz for b in CJ.proj_inf((group, M, n_buckets))))
 
     def step(carry, x):
         planes = carry                # plane carry (packed or limb) x3
@@ -341,7 +341,7 @@ def finish(bx, by, bz, signed=False):
     (reversed) instead of dropping column 0.
     """
     wins, buckets = bz.shape[1], bz.shape[2]
-    c = SCALAR_BITS // wins
+    c = -(-SCALAR_BITS // wins)  # ceil: c=7 gives 37 windows (not 256/37=6)
     assert buckets == (1 << (c - 1) if signed else 1 << c), (wins, buckets)
     add = CJ.proj_add
     vz = bz.ravel()[0] & 0  # varying-zero, see _bucket_scan
@@ -371,9 +371,12 @@ def finish(bx, by, bz, signed=False):
     steps = []
     for k in range(c * (wins - 1)):
         steps.append((0, [k < c * w for w in range(wins)]))
-    h = wins // 2
+    # pairwise tree over a possibly NON-power-of-two window count (37 at
+    # c=7): fold acc[w+h] into acc[w] only where w+h < wins — the roll's
+    # wrap-around lanes are masked off
+    h = 1 << max(0, (wins - 1).bit_length() - 1)
     while h >= 1:
-        steps.append((h, [w < h for w in range(wins)]))
+        steps.append((h, [w < h and w + h < wins for w in range(wins)]))
         h //= 2
     shifts = jnp.asarray(np.array([s for s, _ in steps], dtype=np.int32))
     masks = jnp.asarray(np.array([m for _, m in steps]))
@@ -408,10 +411,13 @@ def bucket_planes_batch(ax, ay, ainf, digits, group):
 
 def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
     """Signed-digit analog of bucket_planes_batch: affine bases (24, nc) +
-    inf mask (nc,) + packed digits (B, W, nc) -> ((24, B*W, 2^(c-1)),)*3."""
+    inf mask (nc,) + packed digits (B, W, nc) -> ((24, B*W, 2^(c-1)),)*3.
+    The window count W determines c (32 -> c=8, 37 -> c=7)."""
     B, W, n = packed.shape
+    c = -(-SCALAR_BITS // W)
     flat = packed.reshape(B * W, n)
-    wb = _bucket_scan_signed(ax, ay, ainf, flat, group)
+    wb = _bucket_scan_signed(ax, ay, ainf, flat, group,
+                             n_buckets=1 << (c - 1))
     planes = tuple(x.transpose(1, 0, 2, 3) for x in wb)
     return fold_planes(*planes)
 
@@ -460,22 +466,31 @@ def digits_of_scalars(scalars, padded_n, c):
 
 
 # NOTE on signed-digit safety: recoding carries can only overflow the top
-# window if a scalar's top radix-256 digit can reach 127; Fr scalars are
-# canonical (< r) and r's top byte is 0x73, so the final carry is always 0
-# and 32 windows suffice. (For c < 8 this margin does not exist at every
-# width, so small-window MSMs keep the unsigned path.)
+# window if a scalar's top window digit can reach the sign threshold; Fr
+# scalars are canonical (< r < 2^255), so at c=8 the top radix-256 digit
+# is <= 0x73 and at c=7 the top (bits 252..258) window is <= 7 — the
+# final carry is always 0 at BOTH widths. Tiny keys (< 256 points) keep
+# the unsigned small-window path for plane-tile reasons, not safety.
 
-def _signed_recode_np(u):
-    """(32, n) uint32 radix-256 digits -> packed signed digits (d + 128),
-    d in [-128, 127] (host numpy)."""
-    out = np.empty_like(u)
-    carry = np.zeros(u.shape[1], dtype=np.uint32)
+def _signed_recode(u, bias, xp):
+    """Windowed unsigned digits -> packed signed digits (d + bias, d in
+    [-bias, bias-1]): the ONE carry loop shared by the host (xp=numpy)
+    and device (xp=jax.numpy) recodes at both window widths (bias 128
+    for c=8, 64 for c=7)."""
+    shift = bias.bit_length()  # 2*bias == 1 << shift
+    outs = []
+    carry = xp.zeros_like(u[0])
     for w in range(u.shape[0]):
         t = u[w] + carry
-        carry = (t >= 128).astype(np.uint32)
-        out[w] = t + 128 - (carry << 8)
-    assert not carry.any(), "signed recode overflow (scalar >= 2^255?)"
-    return out
+        carry = (t >= bias).astype(xp.uint32)
+        outs.append(t + bias - (carry << shift))
+    return outs, carry
+
+
+def _signed_recode_np(u, bias=128):
+    outs, carry = _signed_recode(u, bias, np)
+    assert not np.asarray(carry).any(), "signed recode overflow (>= r?)"
+    return np.stack(outs)
 
 
 def signed_digits_of_scalars(scalars, padded_n):
@@ -486,13 +501,51 @@ def signed_digits_of_scalars(scalars, padded_n):
 def signed_digits_from_mont(v, padded_n):
     """(16, L) Montgomery Fr coefficients -> (32, padded_n) packed signed
     radix-256 digits, entirely on device (32-step static recode loop)."""
-    u = digits_from_mont(v, 8, padded_n)
-    outs = []
-    carry = jnp.zeros_like(u[0])
-    for w in range(u.shape[0]):
-        t = u[w] + carry
-        carry = (t >= 128).astype(jnp.uint32)
-        outs.append(t + 128 - (carry << 8))
+    outs, _ = _signed_recode(digits_from_mont(v, 8, padded_n), 128, jnp)
+    return jnp.stack(outs)
+
+
+# --- c = 7 windows (37 windows x 64 buckets) ---------------------------------
+# Halves the bucket-plane bytes/traffic vs c=8 for +16% window-adds
+# (roadmap #2). 7 does not divide 16, so each window may straddle a limb
+# boundary: window k covers bits [7k, 7k+7), i.e. limb (7k)>>4 shifted by
+# (7k)&15, OR'd with the next limb's low bits when the window crosses.
+# Signed safety at c=7: scalars are canonical (< r < 2^255), so the top
+# window (bits 252..258) is <= 7; recode carries add <= 1 — never >= 64.
+
+W7 = 37  # ceil(256 / 7)
+
+
+def _digits7_rows(limbs, stack):
+    """(16, n) canonical 16-bit limbs -> 37 rows of 7-bit digits (u32)."""
+    rows = []
+    for k in range(W7):
+        bit = 7 * k
+        i, off = bit >> 4, bit & 15
+        lo = limbs[i] >> off
+        if off > 9 and i + 1 < FR_LIMBS:  # window crosses into limb i+1
+            lo = lo | (limbs[i + 1] << (16 - off))
+        rows.append(lo & 127)
+    return stack(rows)
+
+
+def signed_digits7_of_scalars(scalars, padded_n):
+    """Host int scalars -> (37, padded_n) packed signed base-128 digits
+    (d + 64, d in [-64, 63])."""
+    scalars = [s % R_MOD for s in scalars]
+    scalars += [0] * (padded_n - len(scalars))
+    u = _digits7_rows(ints_to_limbs(scalars, FR_LIMBS).astype(np.uint32),
+                      np.stack)
+    return _signed_recode_np(u, bias=64)
+
+
+def signed_digits7_from_mont(v, padded_n):
+    """(16, L) Montgomery Fr coefficients -> (37, padded_n) packed signed
+    base-128 digits, entirely on device."""
+    canon = FJ.from_mont(FR, v)
+    if canon.shape[1] < padded_n:
+        canon = jnp.pad(canon, ((0, 0), (0, padded_n - canon.shape[1])))
+    outs, _ = _signed_recode(_digits7_rows(canon, jnp.stack), 64, jnp)
     return jnp.stack(outs)
 
 
@@ -545,15 +598,17 @@ class MsmContext:
         pad = n % 2  # groups need >= 2 scan steps
         self.padded_n = n + pad
         self.c = window_bits(self.padded_n)
-        # batched pipelines always use 8-bit windows once the key is big
-        # enough: the bucket planes exactly fill (8, 128) minor tiles, where
-        # a 16-bucket (c=4) plane is layout-padded 8x — the difference
-        # between a 1.2 GB and a 10+ GB program at a batched 2^10 commit
-        self.c_batch = 8 if self.padded_n >= 256 else self.c
-        # c=8 runs the SIGNED pipeline (half the buckets, sign folded into
-        # y); both pipelines take affine bases + inf mask and accumulate
-        # with complete projective adds
-        self.signed = self.c_batch == 8
+        # batched pipelines use wide SIGNED windows once the key is big
+        # enough: DPT_MSM_C picks 8 (32 windows x 128 buckets, planes
+        # exactly fill (8, 128) minor tiles) or 7 (37 x 64 — half the
+        # plane traffic per step at +16% window-adds; A/B'd on chip,
+        # msm_c7_ab_r05.json). Tiny keys keep the unsigned small-window
+        # scan (a 16-bucket c=4 plane is layout-padded 8x otherwise).
+        self.c_batch = MsmContext._C_BATCH if self.padded_n >= 256 else self.c
+        # wide windows run the SIGNED pipeline (half the buckets, sign
+        # folded into y); both pipelines take affine bases + inf mask and
+        # accumulate with complete projective adds
+        self.signed = self.c_batch in (7, 8)
         if isinstance(bases, DeviceCommitKey):
             point = bases.point
             if pad:
@@ -567,7 +622,10 @@ class MsmContext:
             self.point = tuple(jax.device_put(p)
                                for p in points_to_device(bases, pad))
         self._platform = next(iter(self.point[0].devices())).platform
-        if self.signed:
+        if self.c_batch == 7:
+            self._digits_batch_fn = jax.jit(
+                partial(signed_digits7_from_mont, padded_n=self.padded_n))
+        elif self.signed:
             self._digits_batch_fn = jax.jit(
                 partial(signed_digits_from_mont, padded_n=self.padded_n))
         else:
@@ -592,6 +650,11 @@ class MsmContext:
     _CALL_TARGET_S = float(os.environ.get("DPT_MSM_CALL_S", "20"))
     _CALL_ADDS_MAX = int(os.environ.get("DPT_MSM_CALL_ADDS_MAX",
                                         str(1 << 28)))
+    # default 7 (37 windows x 64 buckets): chip A/B at 2^20
+    # (msm_c7_ab_r05.json) measured 29.8 s vs 31.4 s for c=8 (~5%), same
+    # result point, both host-oracle-checked at 2^12
+    _C_BATCH = int(os.environ.get("DPT_MSM_C", "7"))
+    assert _C_BATCH in (7, 8), f"DPT_MSM_C must be 7 or 8, got {_C_BATCH}"
 
     def _chunk_fn(self, nc, group):
         key = (nc, group)
@@ -638,7 +701,8 @@ class MsmContext:
         while i0 < n:
             chunk = self._chunk_lanes(B, W)
             nc = min(chunk, n - i0)
-            g = _group_size_batch(nc, B, SCALAR_BITS // W, signed=self.signed)
+            g = _group_size_batch(nc, B, -(-SCALAR_BITS // W),
+                                  signed=self.signed)
             fn = self._chunk_fn(nc, g)
             # calibrate once, on a WARM shape only: a first call's
             # wall-clock is dominated by XLA compilation and would wildly
@@ -729,7 +793,10 @@ class MsmContext:
 
     def msm_many(self, scalar_lists):
         """B MSMs over host int scalar lists in batched launches."""
-        if self.signed:
+        if self.c_batch == 7:
+            make = lambda s: jnp.asarray(
+                signed_digits7_of_scalars(s, self.padded_n))
+        elif self.signed:
             make = lambda s: jnp.asarray(
                 signed_digits_of_scalars(s, self.padded_n))
         else:

@@ -140,17 +140,20 @@ def test_batch_to_affine_roundtrip():
             assert (ax_i[k], ay_i[k]) == pt, k
 
 
-def test_msm_signed_path_matches_oracle():
-    """n >= 256 engages the signed-digit + mixed-add pipeline (c_batch=8);
-    duplicate bases force the P==Q fallback inside the scan, and the edge
-    scalars cover digit 0 / +-max recodings."""
+def test_msm_signed_path_matches_oracle(monkeypatch):
+    """The c=8 signed pipeline (32x128) must keep oracle coverage even
+    though the single-chip default is now c=7 — the mesh context
+    (msm_mesh.py) still runs c=8 unconditionally. Duplicate bases force
+    the P==Q fallback inside the scan, and the edge scalars cover digit
+    0 / +-max recodings."""
+    monkeypatch.setattr(msm_jax.MsmContext, "_C_BATCH", 8)
     n = 256
     distinct = _rand_points(30)
     bases = (distinct * 9)[:n - 2] + [None, None]
     scalars = ([RNG.randrange(R_MOD) for _ in range(n - 4)]
                + [0, 1, R_MOD - 1, 128])
     ctx = msm_jax.MsmContext(bases)
-    assert ctx.signed
+    assert ctx.signed and ctx.c_batch == 8
     assert ctx.msm(scalars) == C.g1_msm(bases, scalars)
 
 
@@ -164,3 +167,43 @@ def test_signed_recode_roundtrip():
         digits = packed.astype(np.int64)[:, 0] - 128
         assert sum(int(d) << (8 * w) for w, d in enumerate(digits)) == s
         assert (np.abs(digits) <= 128).all()
+
+
+def test_signed7_recode_roundtrip():
+    """c=7 packed signed digits (37 windows, bias 64, limb-straddling
+    extraction) reconstruct the scalar exactly."""
+    import numpy as np
+
+    for s in [0, 1, 63, 64, 127, 128, (1 << 254) + 12345, R_MOD - 1,
+              RNG.randrange(R_MOD), RNG.randrange(R_MOD)]:
+        packed = msm_jax.signed_digits7_of_scalars([s], 1)
+        assert packed.shape == (msm_jax.W7, 1)
+        digits = packed.astype(np.int64)[:, 0] - 64
+        assert sum(int(d) << (7 * w) for w, d in enumerate(digits)) == s
+        assert (np.abs(digits) <= 64).all()
+
+
+def test_msm_c7_matches_oracle(monkeypatch):
+    """DPT_MSM_C=7 engages the 37x64 signed pipeline end to end (digit
+    extraction across limb boundaries, 64-bucket planes, ceil-window
+    finish with the non-power-of-two pairwise tree)."""
+    monkeypatch.setattr(msm_jax.MsmContext, "_C_BATCH", 7)
+    n = 256
+    distinct = _rand_points(30)
+    bases = (distinct * 9)[:n - 2] + [None, None]
+    scalars = ([RNG.randrange(R_MOD) for _ in range(n - 4)]
+               + [0, 1, R_MOD - 1, 64])
+    ctx = msm_jax.MsmContext(bases)
+    assert ctx.c_batch == 7 and ctx.signed
+    assert ctx.msm(scalars) == C.g1_msm(bases, scalars)
+    # device digit extraction agrees with the host recode
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.constants import FR_MONT_R
+    from distributed_plonk_tpu.backend.limbs import ints_to_limbs
+
+    h = jnp.asarray(ints_to_limbs(
+        [s * FR_MONT_R % R_MOD for s in scalars], 16))
+    dev = np.asarray(msm_jax.signed_digits7_from_mont(h, ctx.padded_n))
+    host = msm_jax.signed_digits7_of_scalars(scalars, ctx.padded_n)
+    assert np.array_equal(dev, host)
