@@ -170,20 +170,27 @@ def device_mfu():
     def sync(x):
         np.asarray(x[:1, :1])
 
-    # measured f32 FMA ceiling: a sequential chain of full-array FMAs
-    K, shape = 2048, (2048, 4096)
+    # measured f32 FMA ceiling. The body chains INNER dependent FMAs per
+    # array pass so the measurement is compute-bound, not HBM-bound (a
+    # 1-FMA-per-pass chain reads ~12 B/flop and measures bandwidth — the
+    # first bench build reported mul MFU > 100% against it).
+    K, INNER, shape = 32, 128, (2048, 4096)
     y = jnp.full(shape, 1.000001, jnp.float32)
     z = jnp.full(shape, 1e-7, jnp.float32)
 
     @jax.jit
     def chain(x):
-        return lax.fori_loop(0, K, lambda i, v: v * y + z, x)
+        def body(i, v):
+            for _ in range(INNER):
+                v = v * y + z
+            return v
+        return lax.fori_loop(0, K, body, x)
 
     x = jnp.ones(shape, jnp.float32)
     sync(chain(x))  # compile
     t0 = time.perf_counter()
     sync(chain(x))
-    peak = K * shape[0] * shape[1] * 2 / (time.perf_counter() - t0)
+    peak = K * INNER * shape[0] * shape[1] * 2 / (time.perf_counter() - t0)
 
     out = {"f32_fma_tflops_measured": round(peak / 1e12, 3)}
 

@@ -437,12 +437,19 @@ def msm_pipeline_batch(ax, ay, ainf, digits, group):
     return finish_batch(*acc, batch=digits.shape[0])
 
 
-def digits_from_mont(v, c, padded_n):
-    """(16, L) Montgomery Fr coefficients -> (256/c, padded_n) uint32
-    digits, entirely on device (no host round-trip before a commitment)."""
+def _canon_padded(v, padded_n):
+    """(16, L) Montgomery coefficients -> (16, padded_n) canonical limbs
+    (the shared device prologue of every digit-extraction path)."""
     canon = FJ.from_mont(FR, v)
     if canon.shape[1] < padded_n:
         canon = jnp.pad(canon, ((0, 0), (0, padded_n - canon.shape[1])))
+    return canon
+
+
+def digits_from_mont(v, c, padded_n):
+    """(16, L) Montgomery Fr coefficients -> (256/c, padded_n) uint32
+    digits, entirely on device (no host round-trip before a commitment)."""
+    canon = _canon_padded(v, padded_n)
     per_limb = 16 // c
     mask = (1 << c) - 1
     parts = [(canon >> (c * i)) & mask for i in range(per_limb)]
@@ -534,17 +541,14 @@ def signed_digits7_of_scalars(scalars, padded_n):
     (d + 64, d in [-64, 63])."""
     scalars = [s % R_MOD for s in scalars]
     scalars += [0] * (padded_n - len(scalars))
-    u = _digits7_rows(ints_to_limbs(scalars, FR_LIMBS).astype(np.uint32),
-                      np.stack)
+    u = _digits7_rows(ints_to_limbs(scalars, FR_LIMBS), np.stack)
     return _signed_recode_np(u, bias=64)
 
 
 def signed_digits7_from_mont(v, padded_n):
     """(16, L) Montgomery Fr coefficients -> (37, padded_n) packed signed
     base-128 digits, entirely on device."""
-    canon = FJ.from_mont(FR, v)
-    if canon.shape[1] < padded_n:
-        canon = jnp.pad(canon, ((0, 0), (0, padded_n - canon.shape[1])))
+    canon = _canon_padded(v, padded_n)
     outs, _ = _signed_recode(_digits7_rows(canon, jnp.stack), 64, jnp)
     return jnp.stack(outs)
 

@@ -164,6 +164,8 @@ class MeshNttPlan:
             sharded_body, mesh=self.mesh,
             in_specs=(row_spec, const_specs), out_specs=row_spec)
 
+        lane_sh = jax.sharding.NamedSharding(self.mesh, P(None, SHARD_AXIS))
+
         @jax.jit
         def fn(x, cs):
             # pallas only if the MESH devices are TPUs (a cpu mesh can be
@@ -179,6 +181,15 @@ class MeshNttPlan:
                 a = x.reshape(FR_LIMBS, r, c).swapaxes(1, 2)  # A[j2, j1]
                 out = smapped(a, cs)                       # (16, r, c) = X[k1, k2]
                 x = out.swapaxes(1, 2).reshape(FR_LIMBS, n)  # X[k1 + r*k2]
+                # PIN the output to the lane-sharded layout: the swapaxes+
+                # reshape leaves the sharding unconstrained and GSPMD was
+                # observed to REPLICATE the result across the mesh (the
+                # mesh_prove_2p15 residency check measured 25 replicated
+                # coset planes, 463 MiB/device vs the 109 MiB plan). The
+                # constraint costs one relayout collective; round math
+                # downstream then stays O(m/D) per device.
+                if not plain:
+                    x = jax.lax.with_sharding_constraint(x, lane_sh)
                 if plain:
                     with FJ.pallas_disabled():
                         x = FJ.from_mont(FR, x)

@@ -80,7 +80,7 @@ def main():
             results.append({"c": int(c),
                             "error": (proc.stderr or "")[-500:]})
             print(f"[ab]   FAILED rc={proc.returncode}", file=sys.stderr)
-    ok = [r for r in results if "point_x_mod" in r]
+    ok = [r for r in results if r.get("point_x_mod") is not None]
     agree = len(ok) == 2 and ok[0]["point_x_mod"] == ok[1]["point_x_mod"]
     blob = json.dumps({"log_n": args.log_n, "configs": results,
                        "c7_c8_agree": agree})
