@@ -55,9 +55,12 @@ class JaxBackend:
         # would KeyError)
         self._cache_lock = threading.Lock()
         # host-boundary transfer counters (asserted on in tests: mid-prove
-        # traffic must be scalars only)
+        # traffic must be scalars only). `drains` counts the round-3
+        # queue-bounding fences (1-element fetches) separately from the
+        # protocol `lowers`.
         self.lifts = 0
         self.lowers = 0
+        self.drains = 0
 
     # --- plain int-list compute API (worker daemon / dispatcher surface) ----
 
@@ -250,6 +253,15 @@ class JaxBackend:
     # slicing a GSPMD-sharded lane axis would reshard every chunk.
 
     _QUOT_SLICE = int(os.environ.get("DPT_QUOT_SLICE", str(1 << 20)))
+    # drain the device queue every K streamed launches once the quotient
+    # domain is huge: a fully-async warm round 3 enqueues the whole
+    # 25-FFT pipeline before anything frees, and the queued buffer
+    # lifetimes overlap enough to OOM at m=2^23 (scale_2p20_r05b.log
+    # attempts 1-2: cold passes — compile pauses drain the queue — warm
+    # RESOURCE_EXHAUSTEDs). A 1-element fetch costs ~0.1 s per drain.
+    _STREAM_SYNC_EVERY = int(os.environ.get("DPT_STREAM_SYNC_EVERY", "4"))
+    _STREAM_SYNC_MIN_M = int(os.environ.get("DPT_STREAM_SYNC_MIN_M",
+                                            str(1 << 23)))
 
     def coset_fft_many_packed(self, domain, hs):
         """coset_fft_many, but each (16, m) result returns limb-packed
@@ -284,6 +296,23 @@ class JaxBackend:
         acc2_p = PJ.roll_jit(z_p, ratio)  # acc2 starts as z_next
         del base
 
+        sync_every = (self._STREAM_SYNC_EVERY
+                      if m >= self._STREAM_SYNC_MIN_M else 0)
+        launches = 0
+
+        def _throttle(h):
+            nonlocal launches
+            launches += 1
+            if sync_every and launches % sync_every == 0:
+                # 1-element fetch: bounds the async queue. Counted in
+                # `drains`, NOT `lowers` — the lowers counter audits
+                # PROTOCOL transfers (transcript scalars); this is a
+                # fence whose payload is 4 bytes
+                self.drains += 1
+                np.asarray(h[:1, :1])
+
+        _throttle(acc2_p)
+
         beta_c = jnp.asarray(PJ.lift_scalar(beta))
         gamma_c = jnp.asarray(PJ.lift_scalar(gamma))
         w = wires_p
@@ -304,12 +333,14 @@ class JaxBackend:
                 fn, operands = gate_steps[idx]
                 gate_p = fn(gate_p, res[:, j], *operands)
                 idx += 1
+            _throttle(gate_p)
         sj = 0
         for res in self._kernel_batches(quot_domain, list(sigma_h), False, True):
             for j in range(res.shape[1]):
                 acc2_p = PJ.sigma_step_jit(acc2_p, res[:, j], w[sj],
                                            beta_c, gamma_c)
                 sj += 1
+            _throttle(acc2_p)
 
         chunk = min(self._QUOT_SLICE, m)
         assert m % chunk == 0
@@ -322,6 +353,7 @@ class JaxBackend:
                 list(wires_p), z_p, gate_p, acc2_p,
                 tabs["ep"], tabs["zh_inv"], tabs["shifted_inv"],
                 k_arr, *scal, np.uint32(j0), chunk=chunk))
+            _throttle(outs[-1])
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
     def coset_fft_h(self, domain, h):
