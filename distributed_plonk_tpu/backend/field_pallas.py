@@ -177,6 +177,26 @@ def _mont_mul_kernel_lazy(a_ref, b_ref, o_ref, t_ref, *, n_limbs,
       - exact sweeps: low-half carry-out of t+m*p (pair-combined rows
         < 2^31), final reduce r1/r2 pair.
     """
+    def m_band(t_dig2L):
+        m_cols = _band_mul_const(t_ref, ninv_bytes, t_dig2L)[:2 * n_limbs]
+        return _local_round(_local_round(_local_round(m_cols)))  # < 258
+
+    def mp_band(m_dig):
+        return _band_mul_const(t_ref, mod_bytes, m_dig)  # (4L, T), < 2^22
+
+    _lazy_sos(a_ref, b_ref, o_ref, t_ref, n_limbs=n_limbs,
+              negmod_limbs=negmod_limbs, t_rounds=2,
+              m_band=m_band, mp_band=mp_band)
+
+
+def _lazy_sos(a_ref, b_ref, o_ref, t_ref, *, n_limbs, negmod_limbs,
+              t_rounds, m_band, mp_band):
+    """Shared lazy-carry SOS skeleton: VPU a*b band -> t digit rounds ->
+    m_band -> mp_band -> the exact finalize (low-half carry-out sweep +
+    conditional subtract). The two kernel variants differ ONLY in how
+    the constant bands run (VPU byte bands vs MXU Toeplitz matmuls) and
+    in how many local rounds t needs before its band (the MXU band wants
+    digits <= 256 for bf16 exactness; the VPU band tolerates < 513)."""
     L = n_limbs
     a = a_ref[...].astype(jnp.int32)
     b = b_ref[...].astype(jnp.int32)
@@ -184,12 +204,12 @@ def _mont_mul_kernel_lazy(a_ref, b_ref, o_ref, t_ref, *, n_limbs,
     b_by = _to_bytes_f32(b)
 
     t_cols = _band_mul(t_ref, a_by, b_by)          # (4L, T) f32, < 2^22
-    t_dig = _local_round(_local_round(t_cols))     # digits < 513, exact split
+    t_dig = t_cols                                 # exact split at R boundary
+    for _ in range(t_rounds):
+        t_dig = _local_round(t_dig)
 
-    m_cols = _band_mul_const(t_ref, ninv_bytes, t_dig[:2 * L])[:2 * L]
-    m_dig = _local_round(_local_round(_local_round(m_cols)))  # < 258
-
-    mp_cols = _band_mul_const(t_ref, mod_bytes, m_dig)  # (4L, T), < 2^22
+    m_dig = m_band(t_dig[:2 * L])
+    mp_cols = mp_band(m_dig)
 
     lo = _pairs_to_u32(t_dig[:2 * L] + mp_cols[:2 * L])
     _, c_low = _carry_sweep_val(lo, L)             # low half == 0 mod R
@@ -201,6 +221,44 @@ def _mont_mul_kernel_lazy(a_ref, b_ref, o_ref, t_ref, *, n_limbs,
     r1, _ = _carry_sweep_val(hi, L)
     r2, c2 = _carry_sweep_val(hi + negp, L)
     o_ref[...] = jnp.where((c2 != 0)[None], r2, r1).astype(jnp.uint32)
+
+
+def _mont_mul_kernel_mxu(a_ref, b_ref, cn_ref, cp_ref, o_ref, t_ref, *,
+                         n_limbs, mod_limbs, ninv_bytes, mod_bytes,
+                         negmod_limbs):
+    """Lazy-carry SOS with the two CONSTANT bands on the MXU.
+
+    The m-band (ninv x t) and mp-band (p x m) are Toeplitz products by
+    compile-time constants; as (out, 2L) @ (2L, T) bf16 matmuls with f32
+    accumulation they run on the systolic array instead of burning 2/3 of
+    the kernel's VPU FMAs (the measured round-5 multiplier ceiling —
+    BASELINE.md round-6 roadmap #1a). Only the variable a x b band stays
+    on the VPU (per-lane varying operands cannot share MXU weights).
+
+    Exactness: bf16 has 8 significant bits, so integers <= 256 are exact.
+    THREE local rounds after each accumulation bound digits <= 256:
+      t band cols <= 2L*255^2 < 3.13e6 -> r1 <= 255+12192, r2 <= 303,
+      r3 <= 256. The matmul products are <= 255*256 and every f32
+      accumulator sum <= 2L*255*256 < 2^23 < 2^24 — exact. value(m') <=
+      256*(R-1)/255 < 1.004*R, tighter than the VPU lazy kernel's 1.012*R
+      bound, so the same single conditional subtract yields the canonical
+      [0, p) result, BIT-IDENTICAL to the strict kernel.
+    """
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    def m_band(t_dig2L):
+        m_cols = dot(cn_ref[...], t_dig2L.astype(jnp.bfloat16))
+        return _local_round(_local_round(_local_round(m_cols)))  # <= 256
+
+    def mp_band(m_dig):
+        return dot(cp_ref[...], m_dig.astype(jnp.bfloat16))  # (4L, T) < 2^23
+
+    _lazy_sos(a_ref, b_ref, o_ref, t_ref, n_limbs=n_limbs,
+              negmod_limbs=negmod_limbs, t_rounds=3,
+              m_band=m_band, mp_band=mp_band)
 
 
 def _row0_mask_i32(shape):
@@ -254,22 +312,34 @@ def _mont_mul_kernel(a_ref, b_ref, o_ref, t_ref, *, n_limbs, mod_limbs,
     o_ref[...] = jnp.where(take2, r2, r1).astype(jnp.uint32)
 
 
-# DPT_MUL_LAZY selects the lazy-carry kernel (bit-identical outputs).
-# Default ON: the chip A/B (mul_tile_ab_r05.json) measured it ~13-14%
-# faster at every tile width (Fr 17.6->15.2 ns, Fq 45.7->39.7 ns at
-# tile 512), and every config passed the 1024-lane host-oracle check.
-_LAZY = os.environ.get("DPT_MUL_LAZY", "1") != "0"
+# Kernel variant (bit-identical outputs in every case):
+#   DPT_MUL_MXU=1 -> lazy-carry with the constant bands as bf16 Toeplitz
+#     matmuls on the MXU (opt-in: the chip A/B measured parity with the
+#     lazy kernel within relay noise at the default tile — BASELINE.md);
+#   DPT_MUL_LAZY=1 -> all-VPU lazy-carry (round-5 default: the chip A/B
+#     mul_tile_ab_r05.json measured it ~13-14% over strict at every tile
+#     width — Fr 17.6->15.2 ns, Fq 45.7->39.7 ns at tile 512);
+#   else the strict kernel.
+if os.environ.get("DPT_MUL_MXU", "0") != "0":
+    _VARIANT = "mxu"
+elif os.environ.get("DPT_MUL_LAZY", "1") != "0":
+    _VARIANT = "lazy"
+else:
+    _VARIANT = "strict"
+
+_KERNELS = {"mxu": _mont_mul_kernel_mxu, "lazy": _mont_mul_kernel_lazy,
+            "strict": _mont_mul_kernel}
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _mont_mul_flat(spec_key, interpret, lazy, a, b):
+def _mont_mul_flat(spec_key, interpret, variant, a, b):
     """(L, N) x (L, N) -> (L, N), N a multiple of LANE_TILE."""
     from .field_jax import FR, FQ
 
     spec = FR if spec_key == "fr" else FQ
     L = spec.n_limbs
     kernel = functools.partial(
-        _mont_mul_kernel_lazy if lazy else _mont_mul_kernel, n_limbs=L,
+        _KERNELS[variant], n_limbs=L,
         mod_limbs=tuple(int(x) for x in spec.mod_limbs),
         ninv_bytes=tuple(_const_bytes(int_from_limbs(spec.ninv_limbs), 2 * L)),
         mod_bytes=tuple(_const_bytes(int_from_limbs(spec.mod_limbs), 2 * L)),
@@ -280,16 +350,25 @@ def _mont_mul_flat(spec_key, interpret, lazy, a, b):
     n = a.shape[1]
     grid = n // LANE_TILE
     scratch = [pltpu.VMEM((4 * L, LANE_TILE), jnp.float32)]
+    in_specs = [pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
+                pl.BlockSpec((L, LANE_TILE), lambda i: (0, i))]
+    operands = [a, b]
+    if variant == "mxu":
+        # broadcast constant Toeplitz operands: same block every grid step
+        cn = jnp.asarray(spec.ninv_toeplitz, jnp.bfloat16)
+        cp = jnp.asarray(spec.mod_toeplitz, jnp.bfloat16)
+        in_specs += [pl.BlockSpec(cn.shape, lambda i: (0, 0)),
+                     pl.BlockSpec(cp.shape, lambda i: (0, 0))]
+        operands += [cn, cp]
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((L, n), jnp.uint32),
         grid=(grid,),
-        in_specs=[pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
-                  pl.BlockSpec((L, LANE_TILE), lambda i: (0, i))],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
         scratch_shapes=scratch,
         interpret=interpret,
-    )(a, b)
+    )(*operands)
 
 
 def int_from_limbs(limbs):
@@ -317,7 +396,7 @@ def mont_mul(spec, a, b):
     if pad:
         af = jnp.pad(af, ((0, 0), (0, pad)))
         bf = jnp.pad(bf, ((0, 0), (0, pad)))
-    out = _mont_mul_flat(spec.name.lower(), interpret, _LAZY, af, bf)
+    out = _mont_mul_flat(spec.name.lower(), interpret, _VARIANT, af, bf)
     if pad:
         out = out[:, :lanes]
     return out.reshape(shape)

@@ -140,6 +140,16 @@ class JaxBackend:
         self.lowers += 1
         return PJ.lower(h)
 
+    # checkpoint dump/load (checkpoint.py): CANONICAL (16, L) uint32 limb
+    # arrays — the same layout limbs.ints_to_limbs produces — so snapshots
+    # are portable across backends. The int round-trip is skipped: one
+    # device from_mont/to_mont pass instead of 2^20 Python conversions.
+    def dump_h(self, h):
+        return np.asarray(PJ._from_mont_jit(h)).astype(np.uint32, copy=False)
+
+    def load_h(self, arr):
+        return PJ._to_mont_jit(self._lift_arr(np.asarray(arr, np.uint32)))
+
     def wire_values(self, circuit):
         tabs = self._circuit_tables(circuit)
         return [tabs["wires"][:, i] for i in range(NUM_WIRE_TYPES)]

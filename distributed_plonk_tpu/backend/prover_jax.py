@@ -417,6 +417,7 @@ def tail_is_zero(poly, degree):
 # --- module-level jitted entry points (stable wrappers => no retracing) ------
 
 _from_mont_jit = jax.jit(partial(FJ.from_mont, FR))
+_to_mont_jit = jax.jit(partial(FJ.to_mont, FR))
 poly_eval_jit = jax.jit(poly_eval)
 poly_eval_many_jit = jax.jit(poly_eval_many)
 synthetic_divide_jit = jax.jit(synthetic_divide)

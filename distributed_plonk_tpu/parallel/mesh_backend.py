@@ -173,6 +173,7 @@ def _no_pallas(name):
 
 
 for _name in ("blind", "eval_h", "eval_many_h", "lin_comb_h", "synth_div_h",
-              "perm_product", "quotient", "degree_is", "split"):
+              "perm_product", "quotient", "degree_is", "split",
+              "dump_h", "load_h"):
     setattr(MeshBackend, _name, _no_pallas(_name))
 del _name

@@ -41,12 +41,16 @@ class Proof:
         self.perm_next_eval = perm_next_eval
 
 
-def prove(rng, circuit, pk, backend, tracer=None):
+def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
     """Produce a TurboPlonk proof for a finalized, satisfied circuit.
 
     tracer: optional trace.Tracer; records per-round and per-kernel-batch
     wall-clock spans (the reference prints these ad hoc,
-    /root/reference/src/dispatcher.rs:625-942)."""
+    /root/reference/src/dispatcher.rs:625-942).
+    checkpoint: optional checkpoint.ProverCheckpoint; after each of rounds
+    1-4 the inter-round state is persisted, and a prove interrupted at any
+    point resumes from the last completed round, producing byte-identical
+    output (the reference has no checkpointing — SURVEY.md §5)."""
     n = pk.domain_size
     domain = pk.domain
     num_wire_types = NUM_WIRE_TYPES
@@ -62,33 +66,96 @@ def prove(rng, circuit, pk, backend, tracer=None):
 
     sel_h, sigma_h = backend.pk_polys(pk)
 
+    # checkpoint/resume bookkeeping: `start` is the first UNFINISHED round;
+    # completed rounds restore their outputs from the snapshot instead of
+    # recomputing, and the transcript sponge + blinder RNG rewind to the
+    # snapshot point so the challenge schedule continues bit-for-bit
+    start = 0
+    ck_state = fp = None
+    if checkpoint is not None:
+        from .checkpoint import workload_fingerprint
+        fp = workload_fingerprint(pk.vk, pub_input)
+        ck_state = checkpoint.load(fp)
+        if ck_state is not None:
+            start = ck_state["round"]
+            checkpoint.restore_into(ck_state, rng, transcript)
+
+    def _loadh(name):
+        from .checkpoint import load_handle
+        return load_handle(backend, ck_state["arrays"][name])
+
+    def _save(round_no, arrays, meta):
+        if checkpoint is None:
+            return
+        from .checkpoint import dump_handle
+        with tr.span("checkpoint_save", round=round_no):
+            checkpoint.save(
+                round_no, fp, rng, transcript,
+                {k: dump_handle(backend, h) for k, h in arrays.items()},
+                meta)
+
+    def _points(meta_val):
+        from .checkpoint import _point_dec
+        return [_point_dec(v) for v in meta_val]
+
+    # cumulative checkpoint payload: every snapshot must carry all state
+    # the REMAINING rounds read (wire/perm/quotient handles + commitments
+    # + challenges), since earlier snapshots are overwritten
+    ck_arrays = {}
+    ck_meta = {}
+
     # --- Round 1: wire polynomials -------------------------------------------
     # (reference src/dispatcher2.rs:293-323)
-    with tr.span("round1"):
-        with tr.span("ifft_wires", polys=num_wire_types):
-            # one batch call: concurrent across the fleet (join_all,
-            # reference dispatcher2.rs:294-306) / one launch on device
-            wire_coeffs = backend.ifft_many(domain, backend.wire_values(circuit))
-            wire_polys = [backend.blind(coeffs, _rand(rng, 2), n)
-                          for coeffs in wire_coeffs]
-        with tr.span("commit_wires", polys=num_wire_types):
-            wires_poly_comms = backend.commit_many_h(ck, wire_polys)
-    transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
+    if start < 1:
+        with tr.span("round1"):
+            with tr.span("ifft_wires", polys=num_wire_types):
+                # one batch call: concurrent across the fleet (join_all,
+                # reference dispatcher2.rs:294-306) / one launch on device
+                wire_coeffs = backend.ifft_many(domain,
+                                                backend.wire_values(circuit))
+                wire_polys = [backend.blind(coeffs, _rand(rng, 2), n)
+                              for coeffs in wire_coeffs]
+            with tr.span("commit_wires", polys=num_wire_types):
+                wires_poly_comms = backend.commit_many_h(ck, wire_polys)
+        transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
+        ck_arrays.update({"wire_poly_%d" % i: h
+                          for i, h in enumerate(wire_polys)})
+        ck_meta["wires_poly_comms"] = [_enc_point(p) for p in wires_poly_comms]
+        _save(1, ck_arrays, ck_meta)
+    else:
+        wire_polys = [_loadh("wire_poly_%d" % i)
+                      for i in range(num_wire_types)]
+        wires_poly_comms = _points(ck_state["meta"]["wires_poly_comms"])
+        ck_arrays.update(
+            {"wire_poly_%d" % i: h for i, h in enumerate(wire_polys)})
+        ck_meta.update(ck_state["meta"])
 
     # --- Round 2: permutation product ----------------------------------------
     # (reference src/dispatcher2.rs:325-357)
-    beta = transcript.get_and_append_challenge(b"beta")
-    gamma = transcript.get_and_append_challenge(b"gamma")
+    if start < 2:
+        beta = transcript.get_and_append_challenge(b"beta")
+        gamma = transcript.get_and_append_challenge(b"gamma")
 
-    with tr.span("round2"):
-        with tr.span("perm_product"):
-            product_h = backend.perm_product(circuit, beta, gamma, n)
-        with tr.span("ifft_perm"):
-            perm_coeffs = backend.ifft_h(domain, product_h)
-        permutation_poly = backend.blind(perm_coeffs, _rand(rng, 3), n)
-        with tr.span("commit_perm"):
-            prod_perm_poly_comm = backend.commit_h(ck, permutation_poly)
-    transcript.append_commitment(b"perm_poly_comms", prod_perm_poly_comm)
+        with tr.span("round2"):
+            with tr.span("perm_product"):
+                product_h = backend.perm_product(circuit, beta, gamma, n)
+            with tr.span("ifft_perm"):
+                perm_coeffs = backend.ifft_h(domain, product_h)
+            permutation_poly = backend.blind(perm_coeffs, _rand(rng, 3), n)
+            with tr.span("commit_perm"):
+                prod_perm_poly_comm = backend.commit_h(ck, permutation_poly)
+        transcript.append_commitment(b"perm_poly_comms", prod_perm_poly_comm)
+        ck_arrays["permutation_poly"] = permutation_poly
+        ck_meta["beta"], ck_meta["gamma"] = hex(beta), hex(gamma)
+        ck_meta["prod_perm_poly_comm"] = _enc_point(prod_perm_poly_comm)
+        _save(2, ck_arrays, ck_meta)
+    else:
+        permutation_poly = _loadh("permutation_poly")
+        ck_arrays["permutation_poly"] = permutation_poly
+        beta = int(ck_meta["beta"], 16)
+        gamma = int(ck_meta["gamma"], 16)
+        from .checkpoint import _point_dec
+        prod_perm_poly_comm = _point_dec(ck_meta["prod_perm_poly_comm"])
 
     # rounds 3-5 never read the witness/permutation tables; a backend may
     # reclaim that device memory for round 3's quotient-domain working set
@@ -98,7 +165,10 @@ def prove(rng, circuit, pk, backend, tracer=None):
 
     # --- Round 3: quotient polynomial ----------------------------------------
     # (reference src/dispatcher2.rs:360-533)
-    alpha = transcript.get_and_append_challenge(b"alpha")
+    if start >= 3:
+        alpha = int(ck_meta["alpha"], 16)
+    else:
+        alpha = transcript.get_and_append_challenge(b"alpha")
     alpha_sq_div_n = alpha * alpha % R_MOD * fr_inv(n % R_MOD) % R_MOD
 
     # quotient_streamed: single-device backends fold each selector/sigma
@@ -108,7 +178,12 @@ def prove(rng, circuit, pk, backend, tracer=None):
     # backend (whose memory strategy is sharding) run the one-shot
     # unpacked path. Both compute identical values.
     stream = getattr(backend, "quotient_streamed", None)
-    with tr.span("round3"):
+    if start >= 3:
+        split_quot_polys = [_loadh("split_quot_poly_%d" % i)
+                            for i in range(num_wire_types)]
+        split_quot_poly_comms = _points(ck_meta["split_quot_poly_comms"])
+    else:
+      with tr.span("round3"):
         pi_coeffs = backend.ifft_h(
             domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
         if stream is not None:

@@ -1,12 +1,14 @@
 #!/usr/bin/env python3
-"""A/B the Pallas multiplier on the chip: lane-tile width x lazy-carry.
+"""A/B the Pallas multiplier on the chip: lane-tile width x kernel variant.
 
-Both knobs are import-time constants (DPT_PALLAS_LANE_TILE, DPT_MUL_LAZY),
-so each configuration runs in a fresh subprocess. Measures wide Fr/Fq
-mont_mul ns/lane (the rate every NTT stage and MSM add inherits) and
-checks 1024 lanes against the host oracle in every configuration.
+The knobs are import-time constants (DPT_PALLAS_LANE_TILE, plus
+DPT_MUL_LAZY / DPT_MUL_MXU selecting the strict, lazy or mxu kernel),
+so each configuration runs in a fresh subprocess; each result row is
+{"tile", "variant", ...}. Measures wide Fr/Fq mont_mul ns/lane (the rate
+every NTT stage and MSM add inherits) and checks 1024 lanes against the
+host oracle in every configuration.
 
-Usage: python scripts/mul_tile_ab.py [--out FILE]
+Usage: python scripts/mul_tile_ab.py [--out FILE] [--variants lazy,mxu]
 """
 
 import argparse
@@ -30,8 +32,9 @@ from distributed_plonk_tpu.backend.limbs import ints_to_limbs, limbs_to_ints
 def sync(x):
     np.asarray(x[:, :1])
 
+from distributed_plonk_tpu.backend import field_pallas as FP
 out = {"tile": int(os.environ["DPT_PALLAS_LANE_TILE"]),
-       "lazy": os.environ.get("DPT_MUL_LAZY", "0") != "0"}
+       "variant": FP._VARIANT}
 rng_np = np.random.default_rng(7)
 rng = random.Random(9)
 for spec, lanes, mod, mont_r, name in (
@@ -66,24 +69,26 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None)
     ap.add_argument("--tiles", default="512,1024,2048")
+    ap.add_argument("--variants", default="strict,lazy,mxu")
     ap.add_argument("--timeout", type=int, default=1200)
     args = ap.parse_args()
 
     results = []
-    for lazy in ("0", "1"):
+    for variant in args.variants.split(","):
         for tile in args.tiles.split(","):
             env = dict(os.environ,
                        DPT_PALLAS_LANE_TILE=tile,
-                       DPT_MUL_LAZY=lazy,
+                       DPT_MUL_LAZY="1" if variant == "lazy" else "0",
+                       DPT_MUL_MXU="1" if variant == "mxu" else "0",
                        DPT_FIELD_MUL="pallas")
-            print(f"[ab] tile={tile} lazy={lazy} ...", file=sys.stderr)
+            print(f"[ab] tile={tile} variant={variant} ...", file=sys.stderr)
             try:
                 proc = subprocess.run(
                     [sys.executable, "-c", INNER % {"repo": REPO}],
                     env=env, capture_output=True, text=True,
                     timeout=args.timeout)
             except subprocess.TimeoutExpired:
-                results.append({"tile": int(tile), "lazy": lazy == "1",
+                results.append({"tile": int(tile), "variant": variant,
                                 "error": "timeout"})
                 continue
             line = next((l for l in proc.stdout.splitlines()
@@ -92,7 +97,7 @@ def main():
                 results.append(json.loads(line[len("RESULT "):]))
                 print(f"[ab]   -> {line[len('RESULT '):]}", file=sys.stderr)
             else:
-                results.append({"tile": int(tile), "lazy": lazy == "1",
+                results.append({"tile": int(tile), "variant": variant,
                                 "error": (proc.stderr or "")[-500:]})
                 print(f"[ab]   FAILED rc={proc.returncode}", file=sys.stderr)
     blob = json.dumps({"configs": results})

@@ -37,13 +37,15 @@ def test_mont_mul_fq_matches_oracle():
     _check(FQ, Q_MOD, FQ_MONT_R, 64)
 
 
+@pytest.mark.parametrize("variant", ["lazy", "mxu"])
 @pytest.mark.parametrize("spec_key,mod,mont_r", [
     ("fr", R_MOD, FR_MONT_R), ("fq", Q_MOD, FQ_MONT_R)])
-def test_mont_mul_lazy_bit_identical(spec_key, mod, mont_r):
-    """The lazy-carry kernel (semi-normalized digit columns, 3 exact
-    sweeps instead of 5) must be BIT-identical to the strict kernel and
-    the host oracle — its m' representative differs mid-kernel but the
-    final conditional subtract lands on the canonical value."""
+def test_mont_mul_variants_bit_identical(spec_key, mod, mont_r, variant):
+    """Every kernel variant must be BIT-identical to the strict kernel
+    and the host oracle: the lazy kernel (semi-normalized digit columns,
+    3 exact sweeps instead of 5) and the mxu kernel (constant Toeplitz
+    bands as bf16 matmuls) use different mid-kernel m' representatives,
+    but the final conditional subtract lands on the canonical value."""
     spec = FR if spec_key == "fr" else FQ
     n = FP.LANE_TILE  # exactly one grid step
     xs = [RNG.randrange(mod) for _ in range(n)]
@@ -52,11 +54,11 @@ def test_mont_mul_lazy_bit_identical(spec_key, mod, mont_r):
     ys[:4] = [mod - 1, 0, mod - 1, mod - 2]
     a = ints_to_limbs(xs, spec.n_limbs)
     b = ints_to_limbs(ys, spec.n_limbs)
-    strict = np.asarray(FP._mont_mul_flat(spec_key, True, False, a, b))
-    lazy = np.asarray(FP._mont_mul_flat(spec_key, True, True, a, b))
-    assert np.array_equal(strict, lazy)
+    strict = np.asarray(FP._mont_mul_flat(spec_key, True, "strict", a, b))
+    got = np.asarray(FP._mont_mul_flat(spec_key, True, variant, a, b))
+    assert np.array_equal(strict, got)
     r_inv = pow(mont_r, mod - 2, mod)
-    assert limbs_to_ints(lazy) == [
+    assert limbs_to_ints(got) == [
         x * y % mod * r_inv % mod for x, y in zip(xs, ys)]
 
 
