@@ -322,6 +322,41 @@ def inner_main():
     print(json.dumps(out))
 
 
+def service_roundtrip_main():
+    """submit -> prove -> verify through the proof service (host oracle
+    backend, tiny toy domain): the serving-path regression canary. Runs
+    over real TCP via an in-process ProofService; prints one JSON line.
+    Entirely jax-free (service + python backend are pure host code)."""
+    import random as _random
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+    from distributed_plonk_tpu.service.jobs import JobSpec, build_bucket_keys
+    from distributed_plonk_tpu.proof_io import deserialize_proof
+    from distributed_plonk_tpu.verifier import verify
+
+    t0 = time.perf_counter()
+    svc = ProofService(port=0, prover_workers=1).start()
+    try:
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            jid = c.submit({"kind": "toy", "gates": 16, "seed": 42})["job_id"]
+            st = c.wait(jid, timeout_s=240)
+            header, blob = c.result(jid)
+            m = c.metrics()
+        spec = JobSpec.from_wire(header["spec"])
+        vk = build_bucket_keys(spec)[2]
+        pub = [int(x, 16) for x in header["public_input"]]
+        ok = st["state"] == "done" and verify(
+            vk, pub, deserialize_proof(blob), rng=_random.Random(1))
+        print(json.dumps({
+            "service_roundtrip_s": round(time.perf_counter() - t0, 3),
+            "service_verified": bool(ok),
+            "service_wait_s": st["wait_s"],
+            "service_run_s": st["run_s"],
+            "service_jobs_completed": m["counters"].get("jobs_completed", 0),
+        }))
+    finally:
+        svc.shutdown()
+
+
 # --- outer harness (no jax imports past this line) ---------------------------
 
 def _probe_device(timeout_s):
@@ -364,7 +399,7 @@ def _scrubbed_cpu_env():
     return env
 
 
-def _degraded(reason):
+def _degraded(reason, extra=None):
     """Emit the best JSON we can without a reachable TPU: the recorded chip
     numbers under their own clearly-recorded keys (NEVER as this run's
     value — a consumer ignoring the `degraded` flag must not mistake a
@@ -402,7 +437,25 @@ def _degraded(reason):
     if cpu:
         out["cpu_ntt_2p14_device_s"] = cpu.get("ntt_2p14_device_s")
         out["cpu_ntt_2p14_elements_per_s"] = cpu.get("ntt_2p14_elements_per_s")
+    if extra:
+        out.update(extra)
     print(json.dumps(out))
+
+
+def _measure_service_roundtrip():
+    """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
+    keys, or {service_error} — the bench line never fails on it."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--service-roundtrip"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True, text=True,
+            timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300")))
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            if line.strip().startswith("{"):
+                return json.loads(line)
+        return {"service_error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:
+        return {"service_error": repr(e)}
 
 
 def main():
@@ -412,20 +465,40 @@ def main():
             _partial_put = lambda extra: None
         inner_main()
         return
+    if "--service-roundtrip" in sys.argv:
+        service_roundtrip_main()
+        return
     try:
         os.remove(_PARTIAL)
     except OSError:
         pass
+    # the CPU service round-trip is independent of the TPU path: overlap
+    # it with the probe + device measurement instead of serializing ~10 s
+    # (or its whole timeout when the service breaks) onto every run
+    import threading
+    svc_box = {}
+    svc_thread = threading.Thread(
+        target=lambda: svc_box.update(_measure_service_roundtrip()),
+        daemon=True)
+    svc_thread.start()
+
+    def svc():
+        svc_thread.join(
+            timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300")) + 30)
+        return svc_box or {"service_error": "service roundtrip did not finish"}
+
     probe_t = int(os.environ.get("DPT_BENCH_PROBE_TIMEOUT", "150"))
     budget = int(os.environ.get("DPT_BENCH_TIMEOUT", "3000"))
     if not (_probe_device(probe_t) or _probe_device(probe_t)):  # one retry
-        _degraded("device probe failed twice (relay down or platform init hang)")
+        _degraded("device probe failed twice (relay down or platform init hang)",
+                  extra=svc())
         return
     result, err = _run_inner(dict(os.environ), budget)
     if result is not None:
+        result.update(svc())
         print(json.dumps(result))
     else:
-        _degraded(err or "inner measurement failed")
+        _degraded(err or "inner measurement failed", extra=svc())
 
 
 if __name__ == "__main__":

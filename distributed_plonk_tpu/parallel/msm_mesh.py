@@ -41,9 +41,26 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 try:
-    from jax import shard_map as _shard_map
+    from jax import shard_map as _raw_shard_map
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+
+def _shard_map(body, **kwargs):
+    """shard_map with the replication-checker kwarg papered over: newest
+    jax calls it check_vma, older jax check_rep, in-between versions have
+    neither — passing the wrong name is a TypeError, so translate/drop
+    against the installed signature instead of pinning one spelling."""
+    import inspect
+    try:
+        params = set(inspect.signature(_raw_shard_map).parameters)
+    except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+        params = set()
+    if "check_vma" not in params:
+        flag = kwargs.pop("check_vma", None)
+        if "check_rep" in params and flag is not None:
+            kwargs["check_rep"] = flag
+    return _raw_shard_map(body, **kwargs)
 
 from ..constants import FQ_LIMBS
 from ..backend import msm_jax

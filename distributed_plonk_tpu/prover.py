@@ -19,6 +19,8 @@ bytes match FakeStandardTranscript exactly.
 
 import random
 
+from .checkpoint import (_point_dec, _point_enc, dump_handle, load_handle,
+                         workload_fingerprint)
 from .constants import R_MOD
 from .fields import fr_inv
 from .poly import Domain
@@ -73,7 +75,6 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
     start = 0
     ck_state = fp = None
     if checkpoint is not None:
-        from .checkpoint import workload_fingerprint
         fp = workload_fingerprint(pk.vk, pub_input)
         ck_state = checkpoint.load(fp)
         if ck_state is not None:
@@ -81,13 +82,11 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
             checkpoint.restore_into(ck_state, rng, transcript)
 
     def _loadh(name):
-        from .checkpoint import load_handle
         return load_handle(backend, ck_state["arrays"][name])
 
     def _save(round_no, arrays, meta):
         if checkpoint is None:
             return
-        from .checkpoint import dump_handle
         with tr.span("checkpoint_save", round=round_no):
             checkpoint.save(
                 round_no, fp, rng, transcript,
@@ -95,7 +94,6 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
                 meta)
 
     def _points(meta_val):
-        from .checkpoint import _point_dec
         return [_point_dec(v) for v in meta_val]
 
     # cumulative checkpoint payload: every snapshot must carry all state
@@ -118,10 +116,12 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
             with tr.span("commit_wires", polys=num_wire_types):
                 wires_poly_comms = backend.commit_many_h(ck, wire_polys)
         transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
-        ck_arrays.update({"wire_poly_%d" % i: h
-                          for i, h in enumerate(wire_polys)})
-        ck_meta["wires_poly_comms"] = [_enc_point(p) for p in wires_poly_comms]
-        _save(1, ck_arrays, ck_meta)
+        if checkpoint is not None:
+            ck_arrays.update({"wire_poly_%d" % i: h
+                              for i, h in enumerate(wire_polys)})
+            ck_meta["wires_poly_comms"] = [_point_enc(p)
+                                           for p in wires_poly_comms]
+            _save(1, ck_arrays, ck_meta)
     else:
         wire_polys = [_loadh("wire_poly_%d" % i)
                       for i in range(num_wire_types)]
@@ -145,16 +145,16 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
             with tr.span("commit_perm"):
                 prod_perm_poly_comm = backend.commit_h(ck, permutation_poly)
         transcript.append_commitment(b"perm_poly_comms", prod_perm_poly_comm)
-        ck_arrays["permutation_poly"] = permutation_poly
-        ck_meta["beta"], ck_meta["gamma"] = hex(beta), hex(gamma)
-        ck_meta["prod_perm_poly_comm"] = _enc_point(prod_perm_poly_comm)
-        _save(2, ck_arrays, ck_meta)
+        if checkpoint is not None:
+            ck_arrays["permutation_poly"] = permutation_poly
+            ck_meta["beta"], ck_meta["gamma"] = hex(beta), hex(gamma)
+            ck_meta["prod_perm_poly_comm"] = _point_enc(prod_perm_poly_comm)
+            _save(2, ck_arrays, ck_meta)
     else:
         permutation_poly = _loadh("permutation_poly")
         ck_arrays["permutation_poly"] = permutation_poly
         beta = int(ck_meta["beta"], 16)
         gamma = int(ck_meta["gamma"], 16)
-        from .checkpoint import _point_dec
         prod_perm_poly_comm = _point_dec(ck_meta["prod_perm_poly_comm"])
 
     # rounds 3-5 never read the witness/permutation tables; a backend may
@@ -165,12 +165,6 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
 
     # --- Round 3: quotient polynomial ----------------------------------------
     # (reference src/dispatcher2.rs:360-533)
-    if start >= 3:
-        alpha = int(ck_meta["alpha"], 16)
-    else:
-        alpha = transcript.get_and_append_challenge(b"alpha")
-    alpha_sq_div_n = alpha * alpha % R_MOD * fr_inv(n % R_MOD) % R_MOD
-
     # quotient_streamed: single-device backends fold each selector/sigma
     # coset plane into running accumulators as it is produced, so only
     # ~10 limb-packed planes are ever resident (the round-3 working set
@@ -179,70 +173,101 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
     # unpacked path. Both compute identical values.
     stream = getattr(backend, "quotient_streamed", None)
     if start >= 3:
+        # the round-3 snapshot was taken AFTER the quot-comms transcript
+        # absorb, so restoring it must not absorb them again
+        alpha = int(ck_meta["alpha"], 16)
         split_quot_polys = [_loadh("split_quot_poly_%d" % i)
                             for i in range(num_wire_types)]
         split_quot_poly_comms = _points(ck_meta["split_quot_poly_comms"])
+        ck_arrays.update({"split_quot_poly_%d" % i: h
+                          for i, h in enumerate(split_quot_polys)})
     else:
-      with tr.span("round3"):
-        pi_coeffs = backend.ifft_h(
-            domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
-        if stream is not None:
-            with tr.span("quotient_stream", m=m,
-                         polys=len(sel_h) + 2 * num_wire_types + 2):
-                quot_evals = stream(
-                    n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
-                    alpha_sq_div_n, sel_h, sigma_h, wire_polys,
-                    permutation_poly, pi_coeffs)
-        else:
-            with tr.span("coset_ffts",
-                         polys=len(sel_h) + 2 * num_wire_types + 2):
-                # the 24 coset-FFTs go out as one batch (concurrent across
-                # the fleet / one device launch; dispatcher2.rs:382-423)
-                batch = backend.coset_fft_many(
-                    quot_domain,
-                    list(sel_h) + list(sigma_h) + wire_polys
-                    + [permutation_poly, pi_coeffs])
-                ns, nw = len(sel_h), num_wire_types
-                selectors_coset = batch[:ns]
-                sigmas_coset = batch[ns:ns + nw]
-                wires_coset = batch[ns + nw:ns + 2 * nw]
-                z_coset = batch[ns + 2 * nw]
-                pi_coset = batch[ns + 2 * nw + 1]
+        alpha = transcript.get_and_append_challenge(b"alpha")
+        alpha_sq_div_n = alpha * alpha % R_MOD * fr_inv(n % R_MOD) % R_MOD
+        with tr.span("round3"):
+            pi_coeffs = backend.ifft_h(
+                domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
+            if stream is not None:
+                with tr.span("quotient_stream", m=m,
+                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                    quot_evals = stream(
+                        n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
+                        alpha_sq_div_n, sel_h, sigma_h, wire_polys,
+                        permutation_poly, pi_coeffs)
+            else:
+                with tr.span("coset_ffts",
+                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                    # the 24 coset-FFTs go out as one batch (concurrent
+                    # across the fleet / one device launch;
+                    # dispatcher2.rs:382-423)
+                    batch = backend.coset_fft_many(
+                        quot_domain,
+                        list(sel_h) + list(sigma_h) + wire_polys
+                        + [permutation_poly, pi_coeffs])
+                    ns, nw = len(sel_h), num_wire_types
+                    selectors_coset = batch[:ns]
+                    sigmas_coset = batch[ns:ns + nw]
+                    wires_coset = batch[ns + nw:ns + 2 * nw]
+                    z_coset = batch[ns + 2 * nw]
+                    pi_coset = batch[ns + 2 * nw + 1]
 
-            with tr.span("quotient_evals", m=m):
-                quot_evals = backend.quotient(
-                    n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
-                    alpha_sq_div_n, selectors_coset, sigmas_coset,
-                    wires_coset, z_coset, pi_coset,
-                )
-                del batch, selectors_coset, sigmas_coset, wires_coset
-                del z_coset, pi_coset
-        with tr.span("coset_ifft_quot"):
-            quotient_poly = backend.coset_ifft_h(quot_domain, quot_evals)
+                with tr.span("quotient_evals", m=m):
+                    quot_evals = backend.quotient(
+                        n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
+                        alpha_sq_div_n, selectors_coset, sigmas_coset,
+                        wires_coset, z_coset, pi_coset,
+                    )
+                    del batch, selectors_coset, sigmas_coset, wires_coset
+                    del z_coset, pi_coset
+            with tr.span("coset_ifft_quot"):
+                quotient_poly = backend.coset_ifft_h(quot_domain, quot_evals)
 
-        expected_degree = num_wire_types * (n + 1) + 2
-        assert backend.degree_is(quotient_poly, expected_degree), expected_degree
-        # split into num_wire_types chunks of n+2 coefficients
-        # (reference src/dispatcher2.rs:511-525)
-        split_quot_polys = backend.split(
-            quotient_poly, n + 2, num_wire_types, expected_degree + 1)
-        with tr.span("commit_quot", polys=len(split_quot_polys)):
-            split_quot_poly_comms = backend.commit_many_h(ck, split_quot_polys)
-    transcript.append_commitments(b"quot_poly_comms", split_quot_poly_comms)
+            expected_degree = num_wire_types * (n + 1) + 2
+            assert backend.degree_is(quotient_poly, expected_degree), \
+                expected_degree
+            # split into num_wire_types chunks of n+2 coefficients
+            # (reference src/dispatcher2.rs:511-525)
+            split_quot_polys = backend.split(
+                quotient_poly, n + 2, num_wire_types, expected_degree + 1)
+            with tr.span("commit_quot", polys=len(split_quot_polys)):
+                split_quot_poly_comms = backend.commit_many_h(
+                    ck, split_quot_polys)
+        transcript.append_commitments(b"quot_poly_comms",
+                                      split_quot_poly_comms)
+        if checkpoint is not None:
+            ck_arrays.update({"split_quot_poly_%d" % i: h
+                              for i, h in enumerate(split_quot_polys)})
+            ck_meta["alpha"] = hex(alpha)
+            ck_meta["split_quot_poly_comms"] = [
+                _point_enc(p) for p in split_quot_poly_comms]
+            _save(3, ck_arrays, ck_meta)
 
     # --- Round 4: evaluations ------------------------------------------------
     # (reference src/dispatcher2.rs:542-561)
-    zeta = transcript.get_and_append_challenge(b"zeta")
-    with tr.span("round4"):
-        # all 10 evaluations in one backend call (one device round-trip)
-        evals = backend.eval_many_h(
-            [(w, zeta) for w in wire_polys]
-            + [(s, zeta) for s in sigma_h[:num_wire_types - 1]]
-            + [(permutation_poly, zeta * domain.group_gen % R_MOD)])
-        wires_evals = evals[:num_wire_types]
-        wire_sigma_evals = evals[num_wire_types:2 * num_wire_types - 1]
-        perm_next_eval = evals[-1]
-    transcript.append_proof_evaluations(wires_evals, wire_sigma_evals, perm_next_eval)
+    if start >= 4:
+        zeta = int(ck_meta["zeta"], 16)
+        wires_evals = [int(v, 16) for v in ck_meta["wires_evals"]]
+        wire_sigma_evals = [int(v, 16) for v in ck_meta["wire_sigma_evals"]]
+        perm_next_eval = int(ck_meta["perm_next_eval"], 16)
+    else:
+        zeta = transcript.get_and_append_challenge(b"zeta")
+        with tr.span("round4"):
+            # all 10 evaluations in one backend call (one device round-trip)
+            evals = backend.eval_many_h(
+                [(w, zeta) for w in wire_polys]
+                + [(s, zeta) for s in sigma_h[:num_wire_types - 1]]
+                + [(permutation_poly, zeta * domain.group_gen % R_MOD)])
+            wires_evals = evals[:num_wire_types]
+            wire_sigma_evals = evals[num_wire_types:2 * num_wire_types - 1]
+            perm_next_eval = evals[-1]
+        transcript.append_proof_evaluations(wires_evals, wire_sigma_evals,
+                                            perm_next_eval)
+        if checkpoint is not None:
+            ck_meta["zeta"] = hex(zeta)
+            ck_meta["wires_evals"] = [hex(v) for v in wires_evals]
+            ck_meta["wire_sigma_evals"] = [hex(v) for v in wire_sigma_evals]
+            ck_meta["perm_next_eval"] = hex(perm_next_eval)
+            _save(4, ck_arrays, ck_meta)
 
     # --- Round 5: linearization + openings -----------------------------------
     # (reference src/dispatcher2.rs:563-692)
@@ -270,6 +295,12 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
                 permutation_poly, zeta * domain.group_gen % R_MOD)
             opening_proof, shifted_opening_proof = backend.commit_many_h(
                 ck, [witness_poly, shifted_witness_poly])
+
+    # a finished prove must not leave a snapshot behind: a later prove()
+    # pointed at the same path would silently resume at round 5 and emit a
+    # byte-identical proof with REUSED blinds instead of a fresh one
+    if checkpoint is not None:
+        checkpoint.clear()
 
     return Proof(
         wires_poly_comms, prod_perm_poly_comm, split_quot_poly_comms,

@@ -38,6 +38,19 @@ FFT_EXCHANGE = 9   # worker->worker: u64 id, u64 col_start, u64 col_count,
                    # (row_count x col_count) panel of 32B scalars -> OK
 FFT2 = 10          # u64 id -> reply (ce-cs)*c_len*32B stage-2 rows + task GC
 STATS = 11         # -> reply JSON {tag: count} served-request counters
+# --- proof service control plane (service/server.py) -------------------------
+# Rides the exact same framed transport; payloads are JSON (control plane is
+# cold — the hot data plane above keeps its binary codecs).
+SUBMIT = 20        # JSON job spec -> OK + JSON {job_id, ...} | ERR + JSON
+                   # {reason} (admission control rejects loudly, never queues
+                   # past the configured depth)
+STATUS = 21        # JSON {job_id} -> OK + JSON job status snapshot
+RESULT = 22        # JSON {job_id} -> OK + [u32 hdr_len][hdr JSON][proof
+                   # bytes] once DONE; ERR + JSON {reason, state} otherwise
+METRICS = 23       # -> OK + JSON metrics snapshot (queue depth, wait/run
+                   # histograms, per-round latency, throughput)
+KILL_WORKER = 24   # fault injection (serve --chaos only): JSON {job_id |
+                   # worker, at_round?} -> OK + JSON {worker}
 OK = 100
 ERR = 101
 
@@ -185,6 +198,29 @@ def decode_fft_exchange(raw):
     m = decode_scalar_matrix(raw[40:])
     return (task_id, col_start, col_count, row_start,
             m.reshape(16, row_count, col_count))
+
+
+# --- proof service codecs ----------------------------------------------------
+
+def encode_json(obj):
+    import json
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def decode_json(raw):
+    import json
+    return json.loads(raw.decode()) if raw else {}
+
+
+def encode_result(header, blob):
+    """RESULT reply: [u32 header_len][header JSON][opaque proof bytes]."""
+    h = encode_json(header)
+    return struct.pack("<I", len(h)) + h + blob
+
+
+def decode_result(raw):
+    (hlen,) = struct.unpack_from("<I", raw, 0)
+    return decode_json(raw[4:4 + hlen]), raw[4 + hlen:]
 
 
 def encode_ntt_request(values, inverse, coset):

@@ -1,0 +1,34 @@
+"""Multi-client proving service in front of the prover/backends.
+
+The serving layer the ROADMAP's "heavy traffic" north star needs and the
+reference never had (its dispatcher proves exactly one hardcoded workload
+per process, /root/reference/src/dispatcher2.rs:1218-1295):
+
+    client --SUBMIT/STATUS/RESULT/METRICS--> server.ProofService
+        -> queue.JobQueue          (priority, admission control, backpressure)
+        -> scheduler.Scheduler     (shape buckets: shared SRS/pk per bucket,
+                                    compatible jobs batched to amortize keys)
+        -> pool.WorkerPool         (per-job timeout, bounded retry,
+                                    resume-from-checkpoint on worker death)
+        -> metrics.Metrics         (counters + latency histograms, JSON)
+
+The wire control plane rides runtime/protocol.py's framed transport (tags
+SUBMIT/STATUS/RESULT/METRICS/KILL_WORKER). Entry points:
+scripts/serve.py (daemon) and scripts/loadgen.py (concurrent submitters +
+fault injection); tests/test_service.py runs the whole loop in-process.
+"""
+
+from .jobs import Job, JobSpec, build_circuit, build_bucket_keys, shape_key
+from .queue import JobQueue, Rejected
+from .metrics import Metrics
+from .pool import WorkerPool, WorkerKilled, JobTimeout
+from .scheduler import BucketCache, Scheduler
+from .server import ProofService
+from .client import ServiceClient
+
+__all__ = [
+    "Job", "JobSpec", "build_circuit", "build_bucket_keys", "shape_key",
+    "JobQueue", "Rejected", "Metrics", "WorkerPool", "WorkerKilled",
+    "JobTimeout", "BucketCache", "Scheduler", "ProofService",
+    "ServiceClient",
+]

@@ -1,0 +1,91 @@
+"""Client for the proof service wire plane (scripts/loadgen.py, tests).
+
+One framed TCP connection, strict request/reply, thread-safe (a lock
+serializes frames, so concurrent submitters may share one client or open
+one each). Raises ServiceError with the server's JSON reason on ERR."""
+
+import threading
+import time
+
+from ..runtime import native, protocol
+
+
+class ServiceError(Exception):
+    def __init__(self, info):
+        super().__init__(info.get("reason", "service error"))
+        self.info = info
+
+
+class ServiceClient:
+    def __init__(self, host, port, timeout_ms=None):
+        self.conn = native.connect(host, port)
+        if timeout_ms is not None:
+            self.conn.set_timeout(timeout_ms)
+        self._lock = threading.Lock()
+
+    def close(self):
+        self.conn.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _call(self, tag, payload=b""):
+        with self._lock:
+            self.conn.send(tag, payload)
+            rtag, rpayload = self.conn.recv()
+        if rtag != protocol.OK:
+            raise ServiceError(protocol.decode_json(rpayload))
+        return rpayload
+
+    def ping(self):
+        self._call(protocol.PING)
+
+    def submit(self, spec):
+        """spec: JSON-able job dict -> SUBMIT reply dict ({job_id, ...})."""
+        return protocol.decode_json(
+            self._call(protocol.SUBMIT, protocol.encode_json(spec)))
+
+    def status(self, job_id):
+        return protocol.decode_json(
+            self._call(protocol.STATUS,
+                       protocol.encode_json({"job_id": job_id})))
+
+    def result(self, job_id):
+        """-> (header dict, proof bytes). Raises ServiceError (reason
+        not_ready / failure) until the job is DONE."""
+        return protocol.decode_result(
+            self._call(protocol.RESULT,
+                       protocol.encode_json({"job_id": job_id})))
+
+    def metrics(self):
+        return protocol.decode_json(self._call(protocol.METRICS))
+
+    def kill_worker(self, worker=None, job_id=None, at_round=None):
+        req = {}
+        if worker is not None:
+            req["worker"] = worker
+        if job_id is not None:
+            req["job_id"] = job_id
+        if at_round is not None:
+            req["at_round"] = at_round
+        return protocol.decode_json(
+            self._call(protocol.KILL_WORKER,
+                       protocol.encode_json(req)))["worker"]
+
+    def shutdown_server(self):
+        self._call(protocol.SHUTDOWN)
+
+    def wait(self, job_id, timeout_s=120, poll_s=0.05):
+        """Poll STATUS until the job leaves the queue/running states;
+        returns the final status dict. Raises TimeoutError."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            st = self.status(job_id)
+            if st["state"] in ("done", "failed"):
+                return st
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{job_id} still {st['state']}")
+            time.sleep(poll_s)

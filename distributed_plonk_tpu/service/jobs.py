@@ -1,0 +1,225 @@
+"""Job model: wire specs, circuit builders, and bucket (shape) keys.
+
+A job spec names a WORKLOAD FAMILY + parameters + a witness seed, not a
+circuit: the circuit is rebuilt deterministically from the spec on every
+prove attempt (so a checkpoint-resumed retry sees the identical circuit),
+and — crucially for the scheduler — two specs with the same parameters but
+different seeds produce circuits with IDENTICAL structure (gates, wiring,
+selectors): only witness values and the public input differ. That is what
+makes a bucket's SRS + proving key shareable across every job in it
+(verified empirically by tests/test_service.py: proofs made with the
+bucket pk verify under the bucket vk for arbitrary seeds).
+
+Families:
+  toy    {"kind": "toy", "gates": G, "seed": S}
+         add/mul/lc chain, G gates -> domain next_pow2(G + ~4). The
+         small-domain family load tests and tier-1 use.
+  merkle {"kind": "merkle", "height": H, "num_proofs": P,
+          "num_leaves": L?, "seed": S}
+         the paper's Merkle-membership workload (workload.py); structure
+         depends only on (H, P, L) because leaf indices are k % L.
+
+The SRS uses the repo's fixed test tau, so clients can rebuild the
+matching vk locally with build_bucket_keys() and verify results without a
+vk serializer. This is a test-setup service, not a production ceremony.
+"""
+
+import itertools
+import random
+import threading
+import time
+
+from ..circuit import PlonkCircuit
+from ..constants import R_MOD
+
+# same deterministic toxic-waste tau as tests/conftest.py's fixture SRS:
+# server and clients derive identical keys from a spec alone
+TEST_TAU = 0xDEADBEEF
+
+_SPEC_KINDS = ("toy", "merkle")
+
+
+class JobSpec:
+    """Validated job description (the SUBMIT payload)."""
+
+    def __init__(self, kind, params, seed, priority=0):
+        self.kind = kind
+        self.params = params  # shape-determining, seed excluded
+        self.seed = seed
+        self.priority = priority
+
+    @classmethod
+    def from_wire(cls, obj):
+        """Parse + validate an untrusted JSON dict. Raises ValueError with
+        a client-presentable reason."""
+        if not isinstance(obj, dict):
+            raise ValueError("spec must be a JSON object")
+        kind = obj.get("kind")
+        if kind not in _SPEC_KINDS:
+            raise ValueError(f"unknown kind {kind!r} (want one of {_SPEC_KINDS})")
+        seed = obj.get("seed", 0)
+        priority = obj.get("priority", 0)
+        if not isinstance(seed, int) or not isinstance(priority, int):
+            raise ValueError("seed and priority must be integers")
+        if kind == "toy":
+            gates = obj.get("gates")
+            if not isinstance(gates, int) or not 1 <= gates <= 1 << 16:
+                raise ValueError("toy spec needs 1 <= gates <= 65536")
+            params = {"gates": gates}
+        else:
+            height = obj.get("height")
+            num_proofs = obj.get("num_proofs", 1)
+            if not isinstance(height, int) or not 1 <= height <= 64:
+                raise ValueError("merkle spec needs 1 <= height <= 64")
+            if not isinstance(num_proofs, int) or not 1 <= num_proofs <= 1 << 12:
+                raise ValueError("merkle spec needs 1 <= num_proofs <= 4096")
+            num_leaves = obj.get("num_leaves")
+            if num_leaves is None:
+                num_leaves = max(num_proofs, 3)
+            if not isinstance(num_leaves, int) or num_leaves < 1:
+                raise ValueError("num_leaves must be a positive integer")
+            params = {"height": height, "num_proofs": num_proofs,
+                      "num_leaves": num_leaves}
+        return cls(kind, params, seed, priority)
+
+    def to_wire(self):
+        out = {"kind": self.kind, "seed": self.seed,
+               "priority": self.priority}
+        out.update(self.params)
+        return out
+
+
+def shape_key(spec):
+    """Bucket key: everything that determines circuit STRUCTURE (and so
+    the domain size, SRS, proving key, and compiled stages)."""
+    return (spec.kind,) + tuple(sorted(spec.params.items()))
+
+
+def _toy_circuit(gates, seed):
+    rng = random.Random(seed)
+    ckt = PlonkCircuit()
+    x = ckt.create_public_variable(rng.randrange(1, R_MOD))
+    y = ckt.create_public_variable(rng.randrange(1, R_MOD))
+    acc = ckt.add(x, y)
+    for i in range(gates):
+        if i % 3 == 0:
+            acc = ckt.mul(acc, x)
+        elif i % 3 == 1:
+            acc = ckt.add(acc, y)
+        else:
+            acc = ckt.lc([acc, x, y, acc], [1, 2, 3, 4])
+    return ckt
+
+
+def build_circuit(spec):
+    """Spec -> finalized, satisfied circuit (deterministic in the spec)."""
+    if spec.kind == "toy":
+        ckt = _toy_circuit(spec.params["gates"], spec.seed)
+        ok, bad = ckt.check_satisfiability()
+        assert ok, f"toy circuit unsatisfied at gate {bad}"
+        return ckt.finalize()
+    from ..workload import generate_circuit
+    ckt, _tree = generate_circuit(
+        rng=random.Random(spec.seed), height=spec.params["height"],
+        num_proofs=spec.params["num_proofs"],
+        num_leaves=spec.params["num_leaves"])
+    return ckt
+
+
+def build_bucket_keys(spec, backend=None):
+    """(srs, pk, vk) for a spec's SHAPE — seed-independent, so the server's
+    scheduler and a verifying client derive identical keys. Uses the
+    canonical seed-0 circuit purely as the structure donor."""
+    from .. import kzg
+    canonical = JobSpec(spec.kind, dict(spec.params), seed=0)
+    ckt = build_circuit(canonical)
+    srs = kzg.universal_setup(ckt.n + 3, tau=TEST_TAU)
+    pk, vk = kzg.preprocess(srs, ckt, backend=backend)
+    return srs, pk, vk
+
+
+# --- job lifecycle -----------------------------------------------------------
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+_job_seq = itertools.count(1)
+# per-process run token in every job id: ids (and so checkpoint file
+# names under a persistent --ckpt-dir) can never collide with a previous
+# crashed run's, whose counter also started at 1
+_RUN_TOKEN = "%04x" % random.SystemRandom().randrange(1 << 16)
+
+
+class Job:
+    """One submitted proof job. Mutated by exactly one owner at a time
+    (server accept thread -> scheduler -> pool worker); `status()` builds
+    the externally visible JSON snapshot."""
+
+    def __init__(self, spec):
+        self.id = "job-%s-%06d" % (_RUN_TOKEN, next(_job_seq))
+        self.spec = spec
+        self.shape_key = shape_key(spec)
+        self.priority = spec.priority
+        self.state = QUEUED
+        self.submitted_at = time.monotonic()
+        self.scheduled_at = None
+        self.started_at = None
+        self.finished_at = None
+        self.retries = 0
+        self.attempts = []     # [{worker, outcome}]
+        self.worker = None
+        self.batch_id = None
+        self.batch_size = None
+        self.error = None
+        self.proof_bytes = None
+        self.public_input = None
+        self.round_totals = {}
+        self.done_event = threading.Event()
+
+    @property
+    def wait_s(self):
+        """submit -> first prove start (queue + key-build wait)."""
+        if self.started_at is None:
+            return time.monotonic() - self.submitted_at
+        return self.started_at - self.submitted_at
+
+    @property
+    def run_s(self):
+        if self.started_at is None:
+            return None
+        end = self.finished_at or time.monotonic()
+        return end - self.started_at
+
+    def finish_ok(self, proof_bytes, public_input, round_totals):
+        self.proof_bytes = proof_bytes
+        self.public_input = public_input
+        self.round_totals = round_totals
+        self.state = DONE
+        self.finished_at = time.monotonic()
+        self.done_event.set()
+
+    def finish_err(self, reason):
+        self.error = reason
+        self.state = FAILED
+        self.finished_at = time.monotonic()
+        self.done_event.set()
+
+    def status(self):
+        return {
+            "job_id": self.id,
+            "state": self.state,
+            "spec": self.spec.to_wire(),
+            "shape_key": [str(p) for p in self.shape_key],
+            "priority": self.priority,
+            "retries": self.retries,
+            "attempts": list(self.attempts),
+            "worker": self.worker,
+            "batch_id": self.batch_id,
+            "batch_size": self.batch_size,
+            "wait_s": round(self.wait_s, 6),
+            "run_s": None if self.run_s is None else round(self.run_s, 6),
+            "rounds": {k: round(v, 6) for k, v in self.round_totals.items()},
+            "error": self.error,
+        }

@@ -1,0 +1,103 @@
+"""Service observability: counters, gauges, and latency histograms.
+
+The structured upgrade of the worker plane's raw `{tag: count}` STATS
+counters (runtime/worker.py) for the serving layer: one `Metrics` registry
+aggregates queue depth, wait/run latencies, per-prover-round times (fed
+from trace.Tracer totals), retries/kills, and throughput, and snapshots to
+one JSON-able dict for the METRICS wire tag.
+
+Histograms keep a bounded reservoir (uniform sampling past the cap, so
+long runs stay O(1) memory) and report count/sum/min/mean/percentiles
+computed from the reservoir at snapshot time.
+"""
+
+import random
+import threading
+import time
+
+_RESERVOIR = 2048
+
+
+class Histogram:
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._samples = []
+        self._rng = random.Random(0xC0FFEE)
+
+    def record(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if len(self._samples) < _RESERVOIR:
+            self._samples.append(v)
+        else:
+            i = self._rng.randrange(self.count)
+            if i < _RESERVOIR:
+                self._samples[i] = v
+
+    def snapshot(self):
+        if not self.count:
+            return {"count": 0}
+        s = sorted(self._samples)
+
+        def pct(p):
+            return s[min(len(s) - 1, int(p * len(s)))]
+
+        return {
+            "count": self.count,
+            "sum_s": round(self.sum, 6),
+            "min_s": round(self.min, 6),
+            "mean_s": round(self.sum / self.count, 6),
+            "p50_s": round(pct(0.50), 6),
+            "p90_s": round(pct(0.90), 6),
+            "p99_s": round(pct(0.99), 6),
+            "max_s": round(self.max, 6),
+        }
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        self._hists = {}
+        self.started_at = time.monotonic()
+
+    def inc(self, name, by=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def gauge(self, name, value):
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name, seconds):
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.record(seconds)
+
+    def observe_rounds(self, totals):
+        """Fold a prove's trace.Tracer.totals() into per-round histograms
+        (keys like round1..round5, checkpoint_save)."""
+        for span, dur in totals.items():
+            self.observe(f"prove_round/{span}", dur)
+
+    def snapshot(self):
+        with self._lock:
+            done = self._counters.get("jobs_completed", 0)
+            uptime = time.monotonic() - self.started_at
+            return {
+                "uptime_s": round(uptime, 3),
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.snapshot()
+                               for k, h in sorted(self._hists.items())},
+                "throughput_jobs_per_s": round(done / uptime, 6) if uptime else 0.0,
+            }

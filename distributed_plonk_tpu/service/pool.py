@@ -1,0 +1,276 @@
+"""Prover worker pool: per-job timeout, bounded retry, checkpoint resume.
+
+Each worker owns a backend instance and proves one job at a time. Every
+attempt runs with a `checkpoint.ProverCheckpoint` under the job's id, so
+when a worker dies mid-prove the retry does NOT restart at round 1: it
+resumes at the last completed round with the identical transcript/RNG
+state and produces the same bytes the uninterrupted run would have
+(tests/test_checkpoint.py pins that contract; this module is its consumer).
+
+Failure semantics:
+- worker kill (fault injection / crash analog): the worker thread dies and
+  is REPLACED (new generation of the same slot); its in-flight job is
+  requeued with retries+1 and resumes from its snapshot.
+- generic prove error: bounded retry (`max_retries`), also resuming.
+- per-job timeout: checked cooperatively at round boundaries (the
+  checkpoint-save hook), because a Python thread cannot be preempted
+  mid-kernel; a timed-out job fails and its snapshot is removed.
+
+Fault injection (`kill_worker`) arms a flag the victim observes at its
+next round boundary — after the round's snapshot is persisted, modeling a
+crash between "state made durable" and "next round started".
+"""
+
+import os
+import random
+import tempfile
+import threading
+import time
+import queue as _stdlib_queue
+
+from ..checkpoint import ProverCheckpoint
+from ..prover import prove
+from ..proof_io import serialize_proof
+from ..trace import Tracer
+from . import jobs as J
+
+
+class WorkerKilled(Exception):
+    pass
+
+
+class JobTimeout(Exception):
+    pass
+
+
+def _default_backend():
+    from ..backend.python_backend import PythonBackend
+    return PythonBackend()
+
+
+class _GuardedCheckpoint(ProverCheckpoint):
+    """ProverCheckpoint that gives the pool a round-boundary control point:
+    kill flags and deadlines fire here, AFTER the round's snapshot is
+    durable, so the subsequent retry has the maximum state to resume from."""
+
+    def __init__(self, path, worker):
+        super().__init__(path)
+        self.worker = worker
+
+    def load(self, fingerprint):
+        self.worker.check(round_no=0)
+        return super().load(fingerprint)
+
+    def save(self, round_no, *args, **kwargs):
+        super().save(round_no, *args, **kwargs)
+        self.worker.check(round_no=round_no)
+
+
+class _Worker:
+    """One pool slot's current thread. A killed slot respawns as a new
+    generation (`w2g1` -> `w2g2`) — the slot is permanent, threads are not."""
+
+    def __init__(self, index, generation):
+        self.index = index
+        self.generation = generation
+        self.name = f"w{index}g{generation}"
+        self.kill_arm = None       # None | {"at_round": int|None}
+        self.deadline = None
+        self.busy_job = None
+        self.thread = None
+
+    def check(self, round_no=None):
+        arm = self.kill_arm
+        if arm is not None and (arm["at_round"] is None
+                                or arm["at_round"] == round_no):
+            self.kill_arm = None
+            raise WorkerKilled(self.name)
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            raise JobTimeout(f"deadline exceeded on {self.name}")
+
+
+_STOP = object()
+
+
+class WorkerPool:
+    def __init__(self, metrics, prover_workers=2, max_retries=2,
+                 job_timeout_s=None, ckpt_dir=None, backend_factory=None,
+                 verify_on_complete=False):
+        self.metrics = metrics
+        self.max_retries = max_retries
+        self.job_timeout_s = job_timeout_s
+        self.ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="dpt-service-ck-")
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        self.backend_factory = backend_factory or _default_backend
+        self.verify_on_complete = verify_on_complete
+        # small buffer past the worker count: keeps workers fed while the
+        # scheduler builds the next bucket, without hoarding the queue's
+        # jobs where priorities can no longer reorder them
+        self._dispatch_q = _stdlib_queue.Queue(maxsize=2 * prover_workers)
+        self._lock = threading.Lock()
+        self._workers = []
+        self._stopping = False
+        for i in range(prover_workers):
+            self._workers.append(self._spawn(i, 1))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def _spawn(self, index, generation):
+        w = _Worker(index, generation)
+        w.thread = threading.Thread(target=self._loop, args=(w,),
+                                    name=f"pool-{w.name}", daemon=True)
+        w.thread.start()
+        self.metrics.inc("workers_spawned")
+        return w
+
+    def _respawn(self, dead):
+        with self._lock:
+            if self._stopping:
+                return
+            replacement = self._spawn(dead.index, dead.generation + 1)
+            self._workers[dead.index] = replacement
+
+    def shutdown(self):
+        self._stopping = True
+        with self._lock:
+            workers = list(self._workers)
+        for _ in workers:
+            self._dispatch_q.put(_STOP)
+        for w in workers:
+            w.thread.join(timeout=10)
+
+    def workers(self):
+        with self._lock:
+            return list(self._workers)
+
+    # -- dispatch -------------------------------------------------------------
+
+    def dispatch(self, job, resources):
+        """Hand a scheduled job to the pool (blocks for backpressure)."""
+        self._dispatch_q.put((job, resources))
+
+    def kill_worker(self, worker=None, job_id=None, at_round=None):
+        """Fault injection: arm a kill on a specific worker, on whichever
+        worker is proving `job_id`, or on any busy (else any) worker.
+        Returns the victim's name; raises LookupError if no match."""
+        with self._lock:
+            pool = list(self._workers)
+        victim = None
+        if worker is not None:
+            victim = next((w for w in pool if w.name == worker), None)
+        elif job_id is not None:
+            victim = next((w for w in pool
+                           if w.busy_job is not None
+                           and w.busy_job.id == job_id), None)
+        else:
+            victim = next((w for w in pool if w.busy_job is not None),
+                          pool[0] if pool else None)
+        if victim is None:
+            raise LookupError("no such worker/job to kill")
+        victim.kill_arm = {"at_round": at_round}
+        self.metrics.inc("kill_requests")
+        return victim.name
+
+    # -- execution ------------------------------------------------------------
+
+    def _ckpt_path(self, job):
+        return os.path.join(self.ckpt_dir, f"{job.id}.ckpt.npz")
+
+    def _loop(self, worker):
+        backend = self.backend_factory()
+        while True:
+            item = self._dispatch_q.get()
+            if item is _STOP:
+                return
+            job, res = item
+            worker.busy_job = job
+            if job.started_at is None:
+                job.started_at = time.monotonic()
+                self.metrics.observe("job_wait", job.wait_s)
+            job.worker = worker.name
+            job.state = J.RUNNING
+            try:
+                self._run_attempt(worker, backend, job, res)
+                job.attempts.append({"worker": worker.name, "outcome": "ok"})
+                self.metrics.inc("jobs_completed")
+                self.metrics.observe("job_run", job.run_s)
+            except WorkerKilled:
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "killed"})
+                self.metrics.inc("workers_killed")
+                worker.busy_job = None
+                # replacement first: with a 1-worker pool the requeue below
+                # can block on a full dispatch queue until someone consumes
+                self._respawn(worker)
+                self._retry_or_fail(job, res, "worker killed mid-prove")
+                return  # this thread is the "dead process"
+            except JobTimeout:
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "timeout"})
+                self.metrics.inc("jobs_timeout")
+                self._fail(job, f"timeout after {self.job_timeout_s}s")
+            except Exception as e:  # prove/verify error: bounded retry
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": f"error: {e!r}"})
+                self.metrics.inc("job_attempt_errors")
+                self._retry_or_fail(job, res, f"prove failed: {e!r}")
+            finally:
+                worker.busy_job = None
+                # a kill that armed too late to fire on its target (e.g.
+                # during round 5, past the last boundary check) must not
+                # leak onto the worker's next, unrelated job
+                worker.kill_arm = None
+
+    def _retry_or_fail(self, job, res, reason):
+        job.retries += 1
+        if job.retries > self.max_retries:
+            self._fail(job, f"{reason} (retries exhausted)")
+            return
+        self.metrics.inc("job_retries")
+        job.state = J.QUEUED
+        # snapshot stays in place: the retry resumes, not restarts.
+        # NEVER block a worker thread on the requeue: workers are the
+        # dispatch queue's consumers, so a blocking put from one with the
+        # queue full can deadlock the whole pool — hand a full queue off
+        # to a detached putter instead
+        try:
+            self._dispatch_q.put_nowait((job, res))
+        except _stdlib_queue.Full:
+            threading.Thread(target=self._dispatch_q.put, args=((job, res),),
+                             daemon=True).start()
+
+    def _fail(self, job, reason):
+        self.metrics.inc("jobs_failed")
+        try:
+            os.remove(self._ckpt_path(job))
+        except OSError:
+            pass
+        job.finish_err(reason)
+
+    def _run_attempt(self, worker, backend, job, res):
+        if self.job_timeout_s is not None:
+            worker.deadline = job.started_at + self.job_timeout_s
+        try:
+            tracer = Tracer()
+            ckt = J.build_circuit(job.spec)
+            guard = _GuardedCheckpoint(self._ckpt_path(job), worker)
+            try:
+                proof = prove(random.Random(job.spec.seed), ckt, res.pk,
+                              backend, tracer=tracer, checkpoint=guard)
+            except ValueError as e:
+                if "different circuit" in str(e):
+                    # a stale snapshot from some earlier run squats on our
+                    # path: drop it so the retry restarts fresh instead of
+                    # failing identically until retries are exhausted
+                    guard.clear()
+                raise
+            if self.verify_on_complete:
+                from ..verifier import verify
+                assert verify(res.vk, ckt.public_input(), proof,
+                              rng=random.Random(1)), \
+                    "proof failed server-side verification"
+            totals = tracer.totals(depth=1)
+            self.metrics.observe_rounds(totals)
+            job.finish_ok(serialize_proof(proof), ckt.public_input(), totals)
+        finally:
+            worker.deadline = None

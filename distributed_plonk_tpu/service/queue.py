@@ -1,0 +1,75 @@
+"""Priority job queue with admission control and bounded backpressure.
+
+Admission is decided AT SUBMIT TIME, synchronously, so a client always
+learns immediately whether its job is queued or why not (`Rejected.reason`)
+— the queue never grows past `max_depth` and never silently drops work.
+Within the queue, higher `priority` wins; FIFO within a priority class
+(stable sequence numbers, no starvation among equals).
+
+`pop_batch` is the scheduler's accessor: it returns the best job AND every
+other queued job sharing its shape key (up to `max_batch`), so one bucket's
+SRS/proving key build is amortized over the whole compatible batch.
+"""
+
+import threading
+
+
+class Rejected(Exception):
+    """Admission control said no. `reason` is client-presentable."""
+
+    def __init__(self, reason):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class JobQueue:
+    def __init__(self, max_depth=64):
+        self.max_depth = max_depth
+        self._items = []            # [(sort_key, job)], kept sorted on pop
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+        self.high_water = 0
+
+    def depth(self):
+        with self._lock:
+            return len(self._items)
+
+    def submit(self, job):
+        """Enqueue or raise Rejected (queue_full | draining)."""
+        with self._lock:
+            if self._closed:
+                raise Rejected("draining")
+            if len(self._items) >= self.max_depth:
+                raise Rejected("queue_full")
+            self._seq += 1
+            # negative priority first => higher priority pops first
+            self._items.append(((-job.priority, self._seq), job))
+            self.high_water = max(self.high_water, len(self._items))
+            self._nonempty.notify()
+
+    def pop_batch(self, max_batch=1, timeout=None):
+        """Remove and return up to `max_batch` jobs sharing the shape key
+        of the current best (highest-priority, oldest) job. Returns [] on
+        timeout or when closed and empty."""
+        with self._lock:
+            while not self._items:
+                if self._closed or not self._nonempty.wait(timeout):
+                    return []
+            self._items.sort(key=lambda kv: kv[0])
+            head_key = self._items[0][1].shape_key
+            batch, rest = [], []
+            for kv in self._items:
+                if len(batch) < max_batch and kv[1].shape_key == head_key:
+                    batch.append(kv[1])
+                else:
+                    rest.append(kv)
+            self._items = rest
+            return batch
+
+    def close(self):
+        """Stop admitting; wake any blocked pop."""
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify_all()

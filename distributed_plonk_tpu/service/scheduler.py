@@ -1,0 +1,120 @@
+"""Batching scheduler: shape buckets with shared keys, pool dispatch.
+
+Jobs whose specs have the same shape key (jobs.shape_key) are structurally
+identical circuits — same domain, same selectors, same wiring — so they
+can share one SRS + proving/verifying key. The scheduler exploits that two
+ways:
+
+1. BucketCache builds (srs, pk, vk) ONCE per shape, on first demand, and
+   every later job in the bucket skips key setup entirely (at small
+   domains key setup costs more than the prove itself — the cache is the
+   difference between O(jobs) and O(shapes) setups).
+2. JobQueue.pop_batch hands the scheduler the best job plus every queued
+   compatible job, and the whole batch is dispatched against one
+   resources object — so a burst of same-shape traffic touches the cache
+   lock once and lands on the pool back-to-back (maximum key/stage reuse
+   in the workers).
+
+The scheduler is one thread: admission (queue) and execution (pool) are
+concurrent around it, and pool dispatch blocking is the backpressure that
+keeps scheduling from racing ahead of proving capacity.
+"""
+
+import itertools
+import threading
+import time
+
+from . import jobs as J
+
+_batch_seq = itertools.count(1)
+
+
+class BucketResources:
+    """Everything a worker needs to prove any job of one shape."""
+
+    def __init__(self, shape_key, srs, pk, vk, domain_size, build_s):
+        self.shape_key = shape_key
+        self.srs = srs
+        self.pk = pk
+        self.vk = vk
+        self.domain_size = domain_size
+        self.build_s = build_s
+
+
+class BucketCache:
+    def __init__(self, metrics, backend=None):
+        self.metrics = metrics
+        self.backend = backend
+        self._lock = threading.Lock()
+        self._buckets = {}
+
+    def get(self, spec):
+        """Resources for the spec's shape, building them on first use."""
+        key = J.shape_key(spec)
+        with self._lock:
+            res = self._buckets.get(key)
+            if res is not None:
+                self.metrics.inc("bucket_hits")
+                return res
+            # build inside the lock: concurrent first-touch of one shape
+            # must not duplicate a key setup (they are the expensive part)
+            self.metrics.inc("bucket_misses")
+            t0 = time.monotonic()
+            srs, pk, vk = J.build_bucket_keys(spec, backend=self.backend)
+            build_s = time.monotonic() - t0
+            self.metrics.observe("bucket_build", build_s)
+            res = BucketResources(key, srs, pk, vk, vk.domain_size, build_s)
+            self._buckets[key] = res
+            self.metrics.gauge("buckets_resident", len(self._buckets))
+            return res
+
+
+class Scheduler:
+    def __init__(self, queue, pool, metrics, buckets=None, max_batch=8):
+        self.queue = queue
+        self.pool = pool
+        self.metrics = metrics
+        self.buckets = buckets or BucketCache(metrics)
+        self.max_batch = max_batch
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="proof-scheduler", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.queue.close()
+        self._thread.join(timeout=10)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            batch = self.queue.pop_batch(self.max_batch, timeout=0.25)
+            self.metrics.gauge("queue_depth", self.queue.depth())
+            if not batch:
+                continue
+            # the scheduler is ONE thread: an unguarded exception here
+            # (key build OOM on an extreme-but-valid spec, backend error)
+            # would kill scheduling forever while SUBMIT keeps accepting —
+            # fail the batch loudly and keep serving instead
+            try:
+                res = self.buckets.get(batch[0].spec)
+            except Exception as e:
+                self.metrics.inc("bucket_build_errors")
+                for job in batch:
+                    job.finish_err(f"bucket key build failed: {e!r}")
+                continue
+            batch_id = "batch-%05d" % next(_batch_seq)
+            self.metrics.inc("batches_dispatched")
+            self.metrics.observe("batch_size", len(batch))
+            for job in batch:
+                job.scheduled_at = time.monotonic()
+                job.batch_id = batch_id
+                job.batch_size = len(batch)
+                try:
+                    self.pool.dispatch(job, res)
+                except Exception as e:  # pragma: no cover - defensive
+                    self.metrics.inc("dispatch_errors")
+                    job.finish_err(f"dispatch failed: {e!r}")

@@ -1,0 +1,233 @@
+"""TCP frontend: SUBMIT/STATUS/RESULT/METRICS on the runtime wire plane.
+
+Reuses runtime/native.py's framed transport and runtime/protocol.py's tag
+space (the same plane the kernel workers speak), one thread per
+connection like runtime/worker.py — so a deployment speaks ONE protocol
+whether a frame carries an MSM or a proof job. Control payloads are JSON;
+the RESULT reply carries the 944-byte proof_io layout after a JSON header.
+
+`ProofService` is also directly embeddable (tests/test_service.py,
+bench.py drive it in-process through `submit_local`/the client): the TCP
+listener is just one more producer into the queue.
+"""
+
+import os
+import threading
+
+from ..runtime import native, protocol
+from .jobs import Job, JobSpec
+from .metrics import Metrics
+from .pool import WorkerPool
+from .queue import JobQueue, Rejected
+from .scheduler import BucketCache, Scheduler
+
+
+class ProofService:
+    def __init__(self, host="127.0.0.1", port=0, prover_workers=2,
+                 queue_depth=64, max_batch=8, max_retries=2,
+                 job_timeout_s=None, ckpt_dir=None, chaos=False,
+                 backend_factory=None, verify_on_complete=False,
+                 finished_retention=4096, allow_remote_shutdown=False):
+        self.host = host
+        self.port = port
+        self.chaos = chaos
+        self.allow_remote_shutdown = allow_remote_shutdown
+        self.metrics = Metrics()
+        self.queue = JobQueue(max_depth=queue_depth)
+        self.pool = WorkerPool(
+            self.metrics, prover_workers=prover_workers,
+            max_retries=max_retries, job_timeout_s=job_timeout_s,
+            ckpt_dir=ckpt_dir, backend_factory=backend_factory,
+            verify_on_complete=verify_on_complete)
+        self.buckets = BucketCache(self.metrics)
+        self.scheduler = Scheduler(self.queue, self.pool, self.metrics,
+                                   buckets=self.buckets, max_batch=max_batch)
+        self.jobs = {}
+        self.finished_retention = finished_retention
+        self._jobs_lock = threading.Lock()
+        self._listener = None
+        self._stopped = threading.Event()
+
+    # -- local (in-process) API ----------------------------------------------
+
+    def submit_local(self, spec_obj):
+        """Validate + admit one job; returns the Job. Raises ValueError
+        (bad spec) or Rejected (admission control)."""
+        spec = JobSpec.from_wire(spec_obj)
+        job = Job(spec)
+        self.metrics.inc("jobs_submitted")
+        try:
+            self.queue.submit(job)
+        except Rejected:
+            self.metrics.inc("jobs_rejected")
+            raise
+        self.metrics.inc("jobs_accepted")
+        self.metrics.gauge("queue_depth", self.queue.depth())
+        with self._jobs_lock:
+            self.jobs[job.id] = job
+            # bound the job table in a long-running daemon: evict the
+            # oldest FINISHED jobs (dict preserves insertion order) once
+            # past the retention cap — live jobs are never evicted, and
+            # admission control already bounds how many can be live
+            excess = len(self.jobs) - self.finished_retention
+            if excess > 0:
+                # oldest-first (dict insertion order), stop as soon as the
+                # excess is covered — finished jobs cluster at the front,
+                # so this stays O(excess + live prefix), not O(table)
+                evict = []
+                for jid, j in self.jobs.items():
+                    if len(evict) >= excess:
+                        break
+                    if j.state in ("done", "failed"):
+                        evict.append(jid)
+                for jid in evict:
+                    del self.jobs[jid]
+                if evict:
+                    self.metrics.inc("jobs_evicted", len(evict))
+        return job
+
+    def get_job(self, job_id):
+        with self._jobs_lock:
+            return self.jobs.get(job_id)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self):
+        """Start scheduler + listener threads; returns self. With port=0
+        an ephemeral port is chosen and published as `self.port`."""
+        self.scheduler.start()
+        self._listener = native.Listener(self.host, self.port)
+        if self.port == 0:
+            import socket
+            s = socket.socket(fileno=os.dup(self._listener.fd))
+            try:
+                self.port = s.getsockname()[1]
+            finally:
+                s.close()
+        threading.Thread(target=self._accept_loop, name="proof-accept",
+                         daemon=True).start()
+        return self
+
+    def _accept_loop(self):
+        while True:
+            conn = self._listener.accept()
+            if conn.fd < 0:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def serve_forever(self):
+        self._stopped.wait()
+
+    def shutdown(self):
+        self.scheduler.stop()
+        self.pool.shutdown()
+        if self._listener is not None:
+            self._listener.close()
+        self._stopped.set()
+
+    # -- wire handling --------------------------------------------------------
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                try:
+                    tag, payload = conn.recv()
+                except ConnectionError:
+                    return
+                try:
+                    cont = self._dispatch(conn, tag, payload)
+                except Exception as e:
+                    try:
+                        conn.send(protocol.ERR,
+                                  protocol.encode_json({"reason": repr(e)}))
+                    except ConnectionError:
+                        return
+                    continue
+                if cont is False:
+                    self.shutdown()
+                    return
+        finally:
+            conn.close()
+
+    def _dispatch(self, conn, tag, payload):
+        if tag == protocol.PING:
+            conn.send(protocol.OK)
+        elif tag == protocol.SUBMIT:
+            try:
+                job = self.submit_local(protocol.decode_json(payload))
+            except ValueError as e:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": f"bad_spec: {e}"}))
+                return None
+            except Rejected as e:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": e.reason,
+                     "queue_depth": self.queue.depth(),
+                     "max_depth": self.queue.max_depth}))
+                return None
+            conn.send(protocol.OK, protocol.encode_json(
+                {"job_id": job.id,
+                 "shape_key": [str(p) for p in job.shape_key],
+                 "queue_depth": self.queue.depth()}))
+        elif tag == protocol.STATUS:
+            job = self._lookup(conn, payload)
+            if job is not None:
+                conn.send(protocol.OK, protocol.encode_json(job.status()))
+        elif tag == protocol.RESULT:
+            job = self._lookup(conn, payload)
+            if job is None:
+                return None
+            if job.proof_bytes is None:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": "not_ready", "state": job.state,
+                     "error": job.error}))
+                return None
+            header = {"job_id": job.id,
+                      "public_input": [hex(x) for x in job.public_input],
+                      "spec": job.spec.to_wire(),
+                      "retries": job.retries}
+            conn.send(protocol.OK,
+                      protocol.encode_result(header, job.proof_bytes))
+        elif tag == protocol.METRICS:
+            snap = self.metrics.snapshot()
+            snap["gauges"]["queue_depth"] = self.queue.depth()
+            snap["gauges"]["queue_high_water"] = self.queue.high_water
+            conn.send(protocol.OK, protocol.encode_json(snap))
+        elif tag == protocol.KILL_WORKER:
+            if not self.chaos:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": "fault injection disabled (serve --chaos)"}))
+                return None
+            req = protocol.decode_json(payload)
+            try:
+                victim = self.pool.kill_worker(
+                    worker=req.get("worker"), job_id=req.get("job_id"),
+                    at_round=req.get("at_round"))
+            except LookupError as e:
+                conn.send(protocol.ERR,
+                          protocol.encode_json({"reason": str(e)}))
+                return None
+            conn.send(protocol.OK, protocol.encode_json({"worker": victim}))
+        elif tag == protocol.SHUTDOWN:
+            # a multi-client daemon must not die to any one client's frame;
+            # opt in (self-hosted loadgen, tests) or stop it from the host
+            if not self.allow_remote_shutdown:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": "remote shutdown disabled "
+                               "(serve --allow-remote-shutdown)"}))
+                return None
+            conn.send(protocol.OK)
+            return False
+        else:
+            conn.send(protocol.ERR,
+                      protocol.encode_json({"reason": "unknown tag"}))
+        return None
+
+    def _lookup(self, conn, payload):
+        job_id = protocol.decode_json(payload).get("job_id")
+        job = self.get_job(job_id)
+        if job is None:
+            conn.send(protocol.ERR, protocol.encode_json(
+                {"reason": f"unknown job {job_id!r}"}))
+        return job

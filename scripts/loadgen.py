@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""Concurrent load generator + fault injector for the proof service.
+
+    JAX_PLATFORMS=cpu python scripts/loadgen.py            # self-hosted run
+    python scripts/loadgen.py --host 127.0.0.1 --port 9555 # external server
+    python scripts/loadgen.py --jobs 12 --no-kill
+
+Default run: spins up an in-process ProofService (chaos mode, host oracle
+backend), then N submitter threads (default 8, mixed toy domain sizes
+2^5..2^9) each submit over real TCP, wait, fetch, and verify their proof
+client-side (keys rebuilt locally from the spec — same deterministic test
+SRS). Unless --no-kill, one extra large job is the kill target: as soon as
+its STATUS says running, KILL_WORKER is sent for it; the worker dies at
+the next round boundary, the pool respawns a replacement, and the job
+must finish DONE with retries >= 1 (checkpoint resume, not restart).
+
+Prints one JSON summary line; exit code 0 iff every proof verified and
+the injected kill (if any) produced a visible retry.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# mixed shapes: domains 32 / 128 / 256 (toy gate chains)
+_MIX = [{"kind": "toy", "gates": g} for g in (16, 60, 150)]
+_KILL_SPEC = {"kind": "toy", "gates": 300}  # n=512: wide kill window
+
+
+def _verify_result(header, blob, key_cache, lock):
+    from distributed_plonk_tpu.proof_io import deserialize_proof
+    from distributed_plonk_tpu.service.jobs import (JobSpec,
+                                                    build_bucket_keys,
+                                                    shape_key)
+    from distributed_plonk_tpu.verifier import verify
+
+    spec = JobSpec.from_wire(header["spec"])
+    with lock:
+        key = shape_key(spec)
+        if key not in key_cache:
+            key_cache[key] = build_bucket_keys(spec)[2]
+        vk = key_cache[key]
+    pub = [int(x, 16) for x in header["public_input"]]
+    return verify(vk, pub, deserialize_proof(blob), rng=random.Random(1))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default=None,
+                    help="external server (default: self-hosted in-process)")
+    ap.add_argument("--port", type=int, default=9555)
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="pool size for the self-hosted server")
+    ap.add_argument("--no-kill", action="store_true")
+    ap.add_argument("--kill-attempts", type=int, default=3,
+                    help="re-tries if the kill races a finishing prove")
+    ap.add_argument("--timeout", type=float, default=600)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+
+    svc = None
+    host = args.host
+    port = args.port
+    if host is None:
+        svc = ProofService(port=0, prover_workers=args.workers, chaos=True,
+                           allow_remote_shutdown=True).start()
+        host, port = "127.0.0.1", svc.port
+
+    key_cache, key_lock = {}, threading.Lock()
+    results = []
+    results_lock = threading.Lock()
+
+    def submitter(i):
+        spec = dict(_MIX[i % len(_MIX)])
+        spec.update(seed=1000 + i, priority=i % 3)
+        out = {"index": i, "spec": spec}
+        try:
+            with ServiceClient(host, port) as c:
+                out["job_id"] = c.submit(spec)["job_id"]
+                st = c.wait(out["job_id"], timeout_s=args.timeout)
+                out["state"] = st["state"]
+                out["retries"] = st["retries"]
+                out["wait_s"] = st["wait_s"]
+                out["run_s"] = st["run_s"]
+                if st["state"] == "done":
+                    header, blob = c.result(out["job_id"])
+                    out["verified"] = _verify_result(header, blob,
+                                                     key_cache, key_lock)
+                else:
+                    out["error"] = st["error"]
+        except Exception as e:  # noqa: BLE001 - report, don't crash the run
+            out["error"] = repr(e)
+        with results_lock:
+            results.append(out)
+
+    def run_kill_job(attempt):
+        """Submit the kill target, kill its worker once running, wait."""
+        spec = dict(_KILL_SPEC)
+        spec.update(seed=31337 + attempt, priority=9)  # run soon and alone
+        with ServiceClient(host, port) as c:
+            job_id = c.submit(spec)["job_id"]
+            deadline = time.monotonic() + args.timeout
+            victim = None
+            while time.monotonic() < deadline:
+                st = c.status(job_id)
+                if st["state"] in ("done", "failed"):
+                    break
+                if st["state"] == "running" and victim is None:
+                    try:
+                        victim = c.kill_worker(job_id=job_id)
+                    except Exception:
+                        # the prove outran us (finished between the STATUS
+                        # poll and the kill frame); the retry loop below
+                        # sees retries == 0 and tries a fresh target
+                        break
+                time.sleep(0.02)
+            st = c.wait(job_id, timeout_s=args.timeout)
+            out = {"job_id": job_id, "victim": victim,
+                   "state": st["state"], "retries": st["retries"],
+                   "attempts": st["attempts"]}
+            if st["state"] == "done":
+                header, blob = c.result(job_id)
+                out["verified"] = _verify_result(header, blob,
+                                                 key_cache, key_lock)
+            return out
+
+    t0 = time.time()
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(args.jobs)]
+    for t in threads:
+        t.start()
+
+    kill_report = None
+    if not args.no_kill:
+        for attempt in range(args.kill_attempts):
+            kill_report = run_kill_job(attempt)
+            if kill_report.get("retries", 0) >= 1 or \
+                    kill_report["state"] != "done":
+                break  # injected kill landed (or something real broke)
+            # prove outran the kill; try again with a fresh target
+    for t in threads:
+        t.join(timeout=args.timeout)
+
+    with ServiceClient(host, port) as c:
+        metrics = c.metrics()
+        if svc is not None:
+            c.shutdown_server()
+
+    verified = sum(1 for r in results if r.get("verified"))
+    ok = verified == args.jobs
+    if kill_report is not None:
+        ok = ok and kill_report["state"] == "done" \
+            and kill_report.get("verified") \
+            and kill_report["retries"] >= 1
+    summary = {
+        "ok": ok,
+        "wall_s": round(time.time() - t0, 3),
+        "jobs": args.jobs,
+        "verified": verified,
+        "failed": [r for r in results if not r.get("verified")],
+        "kill": kill_report,
+        "metrics": {
+            "counters": metrics["counters"],
+            "gauges": metrics["gauges"],
+            "queue_wait": metrics["histograms"].get("job_wait"),
+            "rounds": {k: v for k, v in metrics["histograms"].items()
+                       if k.startswith("prove_round/")},
+            "throughput_jobs_per_s": metrics["throughput_jobs_per_s"],
+        },
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

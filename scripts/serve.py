@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Run the proof service daemon.
+
+    JAX_PLATFORMS=cpu python scripts/serve.py --port 9555 --workers 2 \
+        [--queue-depth 64] [--max-batch 8] [--retries 2] [--timeout 300] \
+        [--chaos] [--verify]
+
+--chaos enables the KILL_WORKER fault-injection tag (scripts/loadgen.py
+--kill uses it); never enable it on a service you care about. --verify
+makes workers verify each proof server-side before marking it done.
+Prints one JSON line with the bound address once listening; SHUTDOWN tag
+or Ctrl-C stops it.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9555)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--queue-depth", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--retries", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="per-job wall-clock budget, seconds")
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--chaos", action="store_true")
+    ap.add_argument("--verify", action="store_true")
+    ap.add_argument("--allow-remote-shutdown", action="store_true",
+                    help="let any client's SHUTDOWN frame stop the daemon")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_plonk_tpu.service import ProofService
+
+    svc = ProofService(
+        host=args.host, port=args.port, prover_workers=args.workers,
+        queue_depth=args.queue_depth, max_batch=args.max_batch,
+        max_retries=args.retries, job_timeout_s=args.timeout,
+        ckpt_dir=args.ckpt_dir, chaos=args.chaos,
+        verify_on_complete=args.verify,
+        allow_remote_shutdown=args.allow_remote_shutdown).start()
+    print(json.dumps({"listening": f"{svc.host}:{svc.port}",
+                      "workers": args.workers, "chaos": args.chaos}),
+          flush=True)
+    try:
+        svc.serve_forever()
+    except KeyboardInterrupt:
+        svc.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Checkpoint/resume tests (host oracle backend).
+
+The contract claimed by checkpoint.py's docstring, now actually enforced:
+a prove interrupted after ANY saved round (1-4) and resumed from the
+snapshot produces a proof BYTE-IDENTICAL (proof_io fixed layout) to an
+uninterrupted run, and a completed prove leaves no snapshot behind.
+
+Also the tier-1 prove() smoke test that would have caught the round-5
+`_enc_point` NameError: a plain checkpoint-free prove on the host backend.
+"""
+
+import random
+
+import pytest
+
+from distributed_plonk_tpu.backend.python_backend import PythonBackend
+from distributed_plonk_tpu.checkpoint import ProverCheckpoint
+from distributed_plonk_tpu.proof_io import serialize_proof
+from distributed_plonk_tpu.prover import prove
+from distributed_plonk_tpu.verifier import verify
+
+SEED = 7
+
+
+class _Interrupted(Exception):
+    pass
+
+
+class _KillAfterRound(ProverCheckpoint):
+    """Persist the snapshot like the real thing, then die — simulating a
+    worker crash at the round-N boundary (the snapshot is already durable,
+    the process is not)."""
+
+    def __init__(self, path, kill_round):
+        super().__init__(path)
+        self.kill_round = kill_round
+
+    def save(self, round_no, *args, **kwargs):
+        super().save(round_no, *args, **kwargs)
+        if round_no == self.kill_round:
+            raise _Interrupted(f"killed after round {round_no}")
+
+
+@pytest.fixture(scope="module")
+def baseline(proven):
+    """Uninterrupted, checkpoint-free proof bytes at a fixed blind seed."""
+    ckt, pk, vk, _ = proven
+    proof = prove(random.Random(SEED), ckt, pk, PythonBackend())
+    return ckt, pk, vk, serialize_proof(proof)
+
+
+def test_prove_smoke(proven):
+    # checkpoint-free prove must not touch (or crash in) any checkpoint code
+    ckt, pk, vk, _ = proven
+    proof = prove(random.Random(3), ckt, pk, PythonBackend())
+    assert verify(vk, ckt.public_input(), proof, rng=random.Random(4))
+
+
+@pytest.mark.parametrize("kill_round", [1, 2, 3, 4])
+def test_resume_is_byte_identical(tmp_path, baseline, kill_round):
+    ckt, pk, vk, want = baseline
+    path = str(tmp_path / f"kill{kill_round}.ckpt.npz")
+    backend = PythonBackend()
+
+    with pytest.raises(_Interrupted):
+        prove(random.Random(SEED), ckt, pk, backend,
+              checkpoint=_KillAfterRound(path, kill_round))
+    assert (tmp_path / f"kill{kill_round}.ckpt.npz").exists()
+
+    # fresh process analog: new RNG object, new backend, plain checkpoint
+    proof = prove(random.Random(SEED), ckt, pk, PythonBackend(),
+                  checkpoint=ProverCheckpoint(path))
+    assert serialize_proof(proof) == want
+    # clear-on-success: nothing left to resume from
+    assert not (tmp_path / f"kill{kill_round}.ckpt.npz").exists()
+
+
+def test_uninterrupted_checkpointed_prove_matches_and_clears(tmp_path, baseline):
+    ckt, pk, vk, want = baseline
+    path = str(tmp_path / "clean.ckpt.npz")
+    proof = prove(random.Random(SEED), ckt, pk, PythonBackend(),
+                  checkpoint=ProverCheckpoint(path))
+    assert serialize_proof(proof) == want
+    assert not (tmp_path / "clean.ckpt.npz").exists()
+
+
+def test_fingerprint_mismatch_rejected(tmp_path, baseline):
+    from tests.conftest import build_test_circuit
+    from distributed_plonk_tpu import kzg
+
+    ckt, pk, vk, _ = baseline
+    path = str(tmp_path / "fp.ckpt.npz")
+    with pytest.raises(_Interrupted):
+        prove(random.Random(SEED), ckt, pk, PythonBackend(),
+              checkpoint=_KillAfterRound(path, 1))
+
+    # resuming against different keys must raise, not emit a bad proof
+    other = build_test_circuit()
+    other.finalize()
+    srs = kzg.universal_setup(other.n + 3, tau=0xFEEDFACE)
+    pk2, _ = kzg.preprocess(srs, other)
+    with pytest.raises(ValueError, match="different circuit"):
+        prove(random.Random(SEED), other, pk2, PythonBackend(),
+              checkpoint=ProverCheckpoint(path))
