@@ -325,36 +325,64 @@ def inner_main():
 def service_roundtrip_main():
     """submit -> prove -> verify through the proof service (host oracle
     backend, tiny toy domain): the serving-path regression canary. Runs
-    over real TCP via an in-process ProofService; prints one JSON line.
-    Entirely jax-free (service + python backend are pure host code)."""
+    TWICE over real TCP against the same artifact store — a cold process
+    (empty store: full trusted setup + preprocess) and a warm restart
+    (keys served from disk, key-build count must be 0) — so every bench
+    line carries the warm-start speedup. Prints one JSON line. Entirely
+    jax-free (service + python backend are pure host code)."""
     import random as _random
+    import shutil
+    import tempfile
     from distributed_plonk_tpu.service import ProofService, ServiceClient
     from distributed_plonk_tpu.service.jobs import JobSpec, build_bucket_keys
     from distributed_plonk_tpu.proof_io import deserialize_proof
     from distributed_plonk_tpu.verifier import verify
 
-    t0 = time.perf_counter()
-    svc = ProofService(port=0, prover_workers=1).start()
+    store_dir = tempfile.mkdtemp(prefix="dpt-bench-store-")
+
+    def one_run(seed):
+        """(roundtrip_s, status, header, blob, metrics) for one fresh
+        service process-equivalent (new ProofService, same store)."""
+        t0 = time.perf_counter()
+        svc = ProofService(port=0, prover_workers=1, store_dir=store_dir)
+        svc.start()
+        try:
+            with ServiceClient("127.0.0.1", svc.port) as c:
+                jid = c.submit({"kind": "toy", "gates": 16,
+                                "seed": seed})["job_id"]
+                st = c.wait(jid, timeout_s=240)
+                header, blob = c.result(jid)
+                m = c.metrics()
+            return time.perf_counter() - t0, st, header, blob, m
+        finally:
+            svc.shutdown()
+
     try:
-        with ServiceClient("127.0.0.1", svc.port) as c:
-            jid = c.submit({"kind": "toy", "gates": 16, "seed": 42})["job_id"]
-            st = c.wait(jid, timeout_s=240)
-            header, blob = c.result(jid)
-            m = c.metrics()
+        cold_s, st, header, blob, m_cold = one_run(seed=42)
+        warm_s, st_w, _hw, _bw, m_warm = one_run(seed=43)
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
         ok = st["state"] == "done" and verify(
             vk, pub, deserialize_proof(blob), rng=_random.Random(1))
         print(json.dumps({
-            "service_roundtrip_s": round(time.perf_counter() - t0, 3),
+            "service_roundtrip_s": round(cold_s, 3),
+            "service_roundtrip_warm_s": round(warm_s, 3),
+            "service_warm_speedup": round(cold_s / warm_s, 2) if warm_s else None,
             "service_verified": bool(ok),
+            "service_warm_done": st_w["state"] == "done",
+            # contract: a warm restart rebuilds NOTHING for a seen shape
+            "service_warm_key_builds":
+                m_warm["counters"].get("bucket_misses", 0),
+            "service_warm_disk_hits":
+                m_warm["counters"].get("bucket_disk_hits", 0),
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
-            "service_jobs_completed": m["counters"].get("jobs_completed", 0),
+            "service_jobs_completed":
+                m_cold["counters"].get("jobs_completed", 0),
         }))
     finally:
-        svc.shutdown()
+        shutil.rmtree(store_dir, ignore_errors=True)
 
 
 # --- outer harness (no jax imports past this line) ---------------------------

@@ -29,31 +29,48 @@ import jax.numpy as jnp
 # embed host CPU features, and loading another host's entries fails with
 # "machine feature mismatch" warnings (round-2 weakness) — separate
 # subdirectories make every host build/read only its own entries.
-if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    import hashlib as _hashlib
-    import platform as _platform
-    _cpu = ""
-    try:  # CPU feature flags are what the AOT entries actually depend on
-        with open("/proc/cpuinfo") as _f:
-            for _line in _f:
-                if _line.startswith("flags"):
-                    _cpu = _line
+
+def machine_fingerprint():
+    """Stable 12-hex id of what XLA:CPU AOT entries actually depend on:
+    the architecture + CPU feature flags of this host."""
+    import hashlib
+    import platform
+    cpu = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    cpu = line
                     break
     except OSError:
         pass
-    _fp = _hashlib.sha256(
-        f"{_platform.machine()}|{_cpu}".encode()).hexdigest()[:12]
-    _default_cache = os.path.join(
-        os.environ.get(
-            "DPT_JAX_CACHE_DIR",
-            os.path.normpath(os.path.join(
-                os.path.dirname(__file__), "..", "..", ".jax_cache"))),
-        _fp)
+    return hashlib.sha256(
+        f"{platform.machine()}|{cpu}".encode()).hexdigest()[:12]
+
+
+def configure_compile_cache(base_dir, min_compile_secs=1.0):
+    """Point JAX's persistent compile cache at `base_dir/<machine_fp>`.
+
+    Called at import with the repo-local default; the artifact store calls
+    it again (store/warmstart.py) to move the cache under a store root so
+    compiled prover stages ride the same warm-start lifecycle as keys.
+    Returns the per-machine directory, or None when this jax has no
+    persistent-cache config (nothing to wire)."""
+    path = os.path.join(base_dir, machine_fingerprint())
     try:
-        jax.config.update("jax_compilation_cache_dir", _default_cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_secs)
     except Exception:  # pragma: no cover - older jax without these options
-        pass
+        return None
+    return path
+
+
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    configure_compile_cache(os.environ.get(
+        "DPT_JAX_CACHE_DIR",
+        os.path.normpath(os.path.join(
+            os.path.dirname(__file__), "..", "..", ".jax_cache"))))
 
 from ..constants import (
     LIMB_BITS,

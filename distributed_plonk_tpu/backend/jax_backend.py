@@ -183,6 +183,31 @@ class JaxBackend:
         with self._cache_lock:
             self._cache_put(self._pk_polys, id(pk), (pk, list(sel_h), list(sig_h)))
 
+    def warm_stages(self, domain_size, ck=None):
+        """AOT warm-start for one shape bucket (store/warmstart.py's hook).
+
+        Pre-lowers/compiles the NTT kernel variants for the bucket's
+        evaluation domain AND its quotient domain (the two sizes a prove
+        of this shape launches, prover.py:59), at both single-poly and the
+        batch widths _kernel_batches would pick — so the executables are
+        in the persistent compile cache before the first job lands. With
+        `ck`, also builds the commit key's MsmContext and runs one
+        zero-scalar MSM through it (the MSM pipeline's compile is driven
+        by execution, not AOT lowering — a zero MSM costs one bucket-scan
+        pass and bakes the same executable a real commitment needs)."""
+        from ..poly import Domain
+        report = {"ntt": {}}
+        quot = Domain((NUM_WIRE_TYPES + 1) * (domain_size + 1) + 1)
+        elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
+        for dom_n in sorted({domain_size, quot.size}):
+            chunk = max(1, min(self._NTT_BATCH, elems_cap // dom_n))
+            report["ntt"][dom_n] = ntt_jax.get_plan(dom_n).aot_compile(
+                batch_sizes=(chunk,) if chunk > 1 else ())
+        if ck is not None:
+            self._ctx(ck).msm([0])
+            report["msm_warmed"] = True
+        return report
+
     def _kernel(self, domain, h, inverse, coset):
         plan = ntt_jax.get_plan(domain.size)
         if h.shape[1] < domain.size:

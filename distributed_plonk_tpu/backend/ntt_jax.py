@@ -207,6 +207,45 @@ class NttPlan:
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
+    def aot_compile(self, batch_sizes=(), boundaries=("mont", "plain")):
+        """Ahead-of-time lower + compile every (inverse, coset) kernel
+        variant for this domain, plus `kernel_batch` at the given batch
+        widths, WITHOUT running anything — `jit.lower(shapes).compile()`
+        on ShapeDtypeStructs.
+
+        The executables land in the persistent compilation cache
+        (field_jax.configure_compile_cache), which is the point: a warmup
+        process can pre-bake a store-owned cache so every later server
+        start compiles nothing for this shape. The in-process jit dispatch
+        still traces on first real call, but its compile is then a disk
+        hit, not an XLA run. Returns {"compiled": k, "failed": j}."""
+        compiled = failed = 0
+        v_spec = jax.ShapeDtypeStruct((FR_LIMBS, self.n), jnp.uint32)
+
+        def aot(fn, consts, spec):
+            nonlocal compiled, failed
+            cspec = {k: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for k, a in consts.items()}
+            try:
+                fn.lower(spec, cspec).compile()
+                compiled += 1
+            except Exception:  # pragma: no cover - older jax without AOT
+                failed += 1
+
+        for inverse in (False, True):
+            for coset in (False, True):
+                for boundary in boundaries:
+                    self.kernel(inverse, coset, boundary=boundary)
+                    fn, consts = self._fns[(inverse, coset, boundary)]
+                    aot(fn, consts, v_spec)
+                for b in batch_sizes:
+                    self.kernel_batch(inverse, coset)
+                    fn, consts = self._fns[(inverse, coset, "batch")]
+                    aot(fn, consts,
+                        jax.ShapeDtypeStruct((FR_LIMBS, b, self.n),
+                                             jnp.uint32))
+        return {"compiled": compiled, "failed": failed}
+
     # --- host-boundary convenience (int lists, zero-padded to n) -------------
 
     def run_ints(self, values, inverse=False, coset=False):

@@ -134,6 +134,21 @@ def commit_host(ck, coeffs):
     return C.g1_msm(ck[:len(coeffs)], coeffs)
 
 
+def pad_commit_key(powers, srs_size):
+    """Host G1 powers -> commit key: slice to srs_size, pad to a multiple
+    of 32 with the identity, as the dispatcher does (reference
+    src/dispatcher2.rs:207-208) so MSM shard sizes divide evenly.
+
+    Shared by `preprocess` and the artifact store's key deserializer
+    (store/keycache.py) — both must produce the IDENTICAL layout or a
+    disk-loaded proving key would commit differently than a fresh one."""
+    assert len(powers) >= srs_size, "SRS too small for this circuit"
+    ck = list(powers[:srs_size])
+    while len(ck) % 32 != 0:
+        ck.append(None)
+    return ck
+
+
 def preprocess(srs, circuit, backend=None):
     """Build (pk, vk) for a finalized circuit.
 
@@ -164,12 +179,7 @@ def preprocess(srs, circuit, backend=None):
             px, py, pz = (jnp.pad(p, ((0, 0), (0, ext))) for p in (px, py, pz))
         ck = DeviceCommitKey(px, py, pz)
     else:
-        assert len(srs.powers_of_g1) >= srs_size, "SRS too small for this circuit"
-        ck = list(srs.powers_of_g1[:srs_size])
-        # pad ck to a multiple of 32 with the identity, as the dispatcher does
-        # (src/dispatcher2.rs:207-208), so MSM shard sizes divide evenly.
-        while len(ck) % 32 != 0:
-            ck.append(None)
+        ck = pad_commit_key(srs.powers_of_g1, srs_size)
 
     lazy = None
     if backend is not None:

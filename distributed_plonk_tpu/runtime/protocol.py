@@ -51,6 +51,11 @@ METRICS = 23       # -> OK + JSON metrics snapshot (queue depth, wait/run
                    # histograms, per-round latency, throughput)
 KILL_WORKER = 24   # fault injection (serve --chaos only): JSON {job_id |
                    # worker, at_round?} -> OK + JSON {worker}
+WARMUP = 25        # JSON job spec (+ optional "aot": true) -> OK + JSON
+                   # {shape_key, source: memory|disk|built, domain_size,
+                   # warm_s, aot?}: pre-resolve a shape bucket's keys
+                   # through the store tiers and (aot) precompile its
+                   # prover stages, so later SUBMITs of the shape are warm
 OK = 100
 ERR = 101
 

@@ -4,18 +4,22 @@ The serving layer the ROADMAP's "heavy traffic" north star needs and the
 reference never had (its dispatcher proves exactly one hardcoded workload
 per process, /root/reference/src/dispatcher2.rs:1218-1295):
 
-    client --SUBMIT/STATUS/RESULT/METRICS--> server.ProofService
+    client --SUBMIT/STATUS/RESULT/METRICS/WARMUP--> server.ProofService
         -> queue.JobQueue          (priority, admission control, backpressure)
         -> scheduler.Scheduler     (shape buckets: shared SRS/pk per bucket,
-                                    compatible jobs batched to amortize keys)
+                                    compatible jobs batched to amortize keys;
+                                    BucketCache tiers memory -> disk -> build
+                                    over the ../store artifact store)
         -> pool.WorkerPool         (per-job timeout, bounded retry,
                                     resume-from-checkpoint on worker death)
         -> metrics.Metrics         (counters + latency histograms, JSON)
 
 The wire control plane rides runtime/protocol.py's framed transport (tags
-SUBMIT/STATUS/RESULT/METRICS/KILL_WORKER). Entry points:
-scripts/serve.py (daemon) and scripts/loadgen.py (concurrent submitters +
-fault injection); tests/test_service.py runs the whole loop in-process.
+SUBMIT/STATUS/RESULT/METRICS/KILL_WORKER/WARMUP). Entry points:
+scripts/serve.py (daemon), scripts/loadgen.py (concurrent submitters +
+fault injection), and scripts/warmup.py (shape pre-warming / offline store
+provisioning); tests/test_service.py runs the whole loop in-process and
+tests/test_store.py pins the warm-start contracts.
 """
 
 from .jobs import Job, JobSpec, build_circuit, build_bucket_keys, shape_key

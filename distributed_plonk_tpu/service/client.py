@@ -60,6 +60,16 @@ class ServiceClient:
             self._call(protocol.RESULT,
                        protocol.encode_json({"job_id": job_id})))
 
+    def warmup(self, spec, aot=False):
+        """Pre-warm one shape bucket on the server (keys through the store
+        tiers; aot=True also precompiles prover stages). Returns the
+        server's summary dict ({source: memory|disk|built, ...})."""
+        req = dict(spec)
+        if aot:
+            req["aot"] = True
+        return protocol.decode_json(
+            self._call(protocol.WARMUP, protocol.encode_json(req)))
+
     def metrics(self):
         return protocol.decode_json(self._call(protocol.METRICS))
 

@@ -83,6 +83,13 @@ class Metrics:
                 h = self._hists[name] = Histogram()
             h.record(seconds)
 
+    def scoped(self, prefix):
+        """A view of this registry that prefixes every metric name with
+        `prefix_` — how subsystems with their own metric vocabulary (the
+        artifact store's hits/misses/bytes/evictions) publish into the
+        one service registry without hardcoding its namespace."""
+        return _Scoped(self, prefix)
+
     def observe_rounds(self, totals):
         """Fold a prove's trace.Tracer.totals() into per-round histograms
         (keys like round1..round5, checkpoint_save)."""
@@ -101,3 +108,20 @@ class Metrics:
                                for k, h in sorted(self._hists.items())},
                 "throughput_jobs_per_s": round(done / uptime, 6) if uptime else 0.0,
             }
+
+
+class _Scoped:
+    """Name-prefixing adapter over a Metrics registry (see Metrics.scoped)."""
+
+    def __init__(self, base, prefix):
+        self._base = base
+        self._prefix = prefix
+
+    def inc(self, name, by=1):
+        self._base.inc(f"{self._prefix}_{name}", by)
+
+    def gauge(self, name, value):
+        self._base.gauge(f"{self._prefix}_{name}", value)
+
+    def observe(self, name, seconds):
+        self._base.observe(f"{self._prefix}_{name}", seconds)

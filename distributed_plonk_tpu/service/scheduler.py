@@ -5,10 +5,13 @@ identical circuits — same domain, same selectors, same wiring — so they
 can share one SRS + proving/verifying key. The scheduler exploits that two
 ways:
 
-1. BucketCache builds (srs, pk, vk) ONCE per shape, on first demand, and
-   every later job in the bucket skips key setup entirely (at small
-   domains key setup costs more than the prove itself — the cache is the
-   difference between O(jobs) and O(shapes) setups).
+1. BucketCache resolves (srs, pk, vk) ONCE per shape, on first demand,
+   through three tiers — bounded in-memory LRU, on-disk artifact store
+   (persists across restarts), full build — and every later job in the
+   bucket skips key setup entirely (at small domains key setup costs more
+   than the prove itself — the cache is the difference between O(jobs)
+   and O(shapes) setups, and the disk tier makes that hold across
+   process lifetimes).
 2. JobQueue.pop_batch hands the scheduler the best job plus every queued
    compatible job, and the whole batch is dispatched against one
    resources object — so a burst of same-shape traffic touches the cache
@@ -23,8 +26,10 @@ keeps scheduling from racing ahead of proving capacity.
 import itertools
 import threading
 import time
+from collections import OrderedDict
 
 from . import jobs as J
+from ..store import keycache as KC
 
 _batch_seq = itertools.count(1)
 
@@ -42,31 +47,80 @@ class BucketResources:
 
 
 class BucketCache:
-    def __init__(self, metrics, backend=None):
+    """Three-tier shape-bucket key cache: memory -> disk -> build.
+
+    Tier 1 is a BOUNDED in-memory LRU (`max_entries`; the PR-1 version
+    grew without limit — at 2^18-domain shapes one resident bucket is
+    hundreds of MB of SRS+pk, so a long-lived daemon serving many shapes
+    needs the cap). Tier 2 is the on-disk ArtifactStore (`store`), where
+    keys persist across process restarts and are shared with warmup jobs;
+    integrity failures there self-heal (the corrupt entry is deleted and
+    the build tier repopulates it). Tier 3 is `jobs.build_bucket_keys`.
+
+    Metrics: bucket_hits (memory), bucket_disk_hits, bucket_misses
+    (full build), bucket_mem_evictions, plus the store's own store_*
+    counters/gauges.
+    """
+
+    def __init__(self, metrics, backend=None, store=None, max_entries=None):
         self.metrics = metrics
         self.backend = backend
+        self.store = store
+        self.max_entries = max_entries
         self._lock = threading.Lock()
-        self._buckets = {}
+        self._buckets = OrderedDict()
 
     def get(self, spec):
-        """Resources for the spec's shape, building them on first use."""
+        """Resources for the spec's shape, loading/building on first use."""
+        return self.get_with_source(spec)[0]
+
+    def get_with_source(self, spec):
+        """(resources, tier) where tier is memory|disk|built — the WARMUP
+        handler reports it so operators can see what a warmup did."""
         key = J.shape_key(spec)
         with self._lock:
             res = self._buckets.get(key)
             if res is not None:
+                self._buckets.move_to_end(key)
                 self.metrics.inc("bucket_hits")
-                return res
-            # build inside the lock: concurrent first-touch of one shape
-            # must not duplicate a key setup (they are the expensive part)
-            self.metrics.inc("bucket_misses")
-            t0 = time.monotonic()
-            srs, pk, vk = J.build_bucket_keys(spec, backend=self.backend)
-            build_s = time.monotonic() - t0
-            self.metrics.observe("bucket_build", build_s)
-            res = BucketResources(key, srs, pk, vk, vk.domain_size, build_s)
+                return res, "memory"
+            # load/build inside the lock: concurrent first-touch of one
+            # shape must not duplicate a key setup (the expensive part)
+            res, source = self._load_or_build(spec, key)
             self._buckets[key] = res
+            if self.max_entries is not None \
+                    and len(self._buckets) > self.max_entries:
+                self._buckets.popitem(last=False)  # LRU out
+                self.metrics.inc("bucket_mem_evictions")
             self.metrics.gauge("buckets_resident", len(self._buckets))
-            return res
+            return res, source
+
+    def _load_or_build(self, spec, key):
+        if self.store is not None:
+            t0 = time.monotonic()
+            hit = KC.load_bucket(self.store, key)
+            if hit is not None:
+                srs, pk, vk, meta = hit
+                self.metrics.inc("bucket_disk_hits")
+                self.metrics.observe("bucket_disk_load",
+                                     time.monotonic() - t0)
+                return BucketResources(key, srs, pk, vk, vk.domain_size,
+                                       meta.get("build_s") or 0.0), "disk"
+        self.metrics.inc("bucket_misses")
+        t0 = time.monotonic()
+        srs, pk, vk = J.build_bucket_keys(spec, backend=self.backend)
+        build_s = time.monotonic() - t0
+        self.metrics.observe("bucket_build", build_s)
+        res = BucketResources(key, srs, pk, vk, vk.domain_size, build_s)
+        if self.store is not None:
+            # persistence is best-effort: a full disk or unwritable store
+            # must degrade to cold starts, never fail the build's jobs
+            try:
+                KC.store_bucket(self.store, key, srs, pk, vk,
+                                build_s=build_s)
+            except Exception:  # pragma: no cover - environmental
+                self.metrics.inc("store_write_errors")
+        return res, "built"
 
 
 class Scheduler:

@@ -1,4 +1,4 @@
-"""TCP frontend: SUBMIT/STATUS/RESULT/METRICS on the runtime wire plane.
+"""TCP frontend: SUBMIT/STATUS/RESULT/METRICS/WARMUP on the runtime wire plane.
 
 Reuses runtime/native.py's framed transport and runtime/protocol.py's tag
 space (the same plane the kernel workers speak), one thread per
@@ -13,8 +13,10 @@ listener is just one more producer into the queue.
 
 import os
 import threading
+import time
 
 from ..runtime import native, protocol
+from ..store import ArtifactStore, aot_warmup
 from .jobs import Job, JobSpec
 from .metrics import Metrics
 from .pool import WorkerPool
@@ -27,7 +29,8 @@ class ProofService:
                  queue_depth=64, max_batch=8, max_retries=2,
                  job_timeout_s=None, ckpt_dir=None, chaos=False,
                  backend_factory=None, verify_on_complete=False,
-                 finished_retention=4096, allow_remote_shutdown=False):
+                 finished_retention=4096, allow_remote_shutdown=False,
+                 store_dir=None, store_byte_budget=None, bucket_cap=64):
         self.host = host
         self.port = port
         self.chaos = chaos
@@ -39,9 +42,23 @@ class ProofService:
             max_retries=max_retries, job_timeout_s=job_timeout_s,
             ckpt_dir=ckpt_dir, backend_factory=backend_factory,
             verify_on_complete=verify_on_complete)
-        self.buckets = BucketCache(self.metrics)
+        self.store = None
+        if store_dir is not None:
+            # NOTE: the service does not repoint the JAX compile cache —
+            # an embedded ProofService (tests, bench) must not hijack its
+            # host process's cache config. Daemon entry points that OWN
+            # their process call store.set_jax_cache_env themselves
+            # (scripts/serve.py) so compiled stages warm-start alongside
+            # the keys they serve.
+            self.store = ArtifactStore(store_dir,
+                                       byte_budget=store_byte_budget,
+                                       metrics=self.metrics.scoped("store"))
+        self.buckets = BucketCache(self.metrics, store=self.store,
+                                   max_entries=bucket_cap)
         self.scheduler = Scheduler(self.queue, self.pool, self.metrics,
                                    buckets=self.buckets, max_batch=max_batch)
+        self._warm_backend = None
+        self._warm_backend_lock = threading.Lock()
         self.jobs = {}
         self.finished_retention = finished_retention
         self._jobs_lock = threading.Lock()
@@ -89,6 +106,34 @@ class ProofService:
     def get_job(self, job_id):
         with self._jobs_lock:
             return self.jobs.get(job_id)
+
+    def warmup_local(self, spec_obj, aot=False):
+        """Pre-resolve one shape bucket through the cache tiers (memory ->
+        store -> build; a build lands in the store) and, with aot=True,
+        precompile its prover stages on a pool-equivalent backend. Returns
+        the summary the WARMUP tag replies with. Raises ValueError on a
+        bad spec."""
+        spec = JobSpec.from_wire(spec_obj)
+        self.metrics.inc("warmups")
+        t0 = time.monotonic()
+        res, source = self.buckets.get_with_source(spec)
+        out = {
+            "shape_key": [str(p) for p in res.shape_key],
+            "source": source,
+            "domain_size": res.domain_size,
+            "build_s": round(res.build_s, 6),
+            "warm_s": round(time.monotonic() - t0, 6),
+        }
+        if aot:
+            # same factory the pool workers use, so what we compile is
+            # what they run; one shared instance — stage compiles are
+            # cached process-wide (NTT plans) / on disk (persistent cache)
+            with self._warm_backend_lock:
+                if self._warm_backend is None:
+                    self._warm_backend = self.pool.backend_factory()
+                backend = self._warm_backend
+            out["aot"] = aot_warmup(backend, res.domain_size, ck=res.pk.ck)
+        return out
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -189,6 +234,16 @@ class ProofService:
                       "retries": job.retries}
             conn.send(protocol.OK,
                       protocol.encode_result(header, job.proof_bytes))
+        elif tag == protocol.WARMUP:
+            req = protocol.decode_json(payload)
+            aot = bool(req.pop("aot", False))
+            try:
+                out = self.warmup_local(req, aot=aot)
+            except ValueError as e:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": f"bad_spec: {e}"}))
+                return None
+            conn.send(protocol.OK, protocol.encode_json(out))
         elif tag == protocol.METRICS:
             snap = self.metrics.snapshot()
             snap["gauges"]["queue_depth"] = self.queue.depth()

@@ -1,0 +1,261 @@
+"""Content-addressed on-disk artifact store with integrity + LRU eviction.
+
+The persistence layer under the proof service's warm-start path
+(store/keycache.py serializes bucket keys into it; scheduler.BucketCache
+is its main consumer). Inference-stack shape: a model-weights /
+compiled-program cache, specialized to proving artifacts.
+
+Layout under `root`:
+
+    manifest.json            versioned index: key -> {digest, bytes, seq, meta}
+    objects/ab/abcdef...bin  blobs, named by their SHA-256 (content-addressed)
+    jax_cache/<machine_fp>/  store-owned JAX persistent compile cache
+                             (managed by store/warmstart.py, not this module)
+
+Contracts:
+- Every write is atomic (tmp file + os.replace), manifest included, so a
+  crash mid-write can never leave a referenced-but-truncated entry: either
+  the old manifest (no reference) or the new one (fully written blob).
+- `get` re-verifies SHA-256 over the full blob on every read. An integrity
+  failure (truncation, bit rot, a partial copy) logs, DELETES the entry,
+  and returns None — callers fall through to a fresh build instead of
+  crashing (service satellite contract, tests/test_store.py).
+- LRU byte-budget eviction: each hit bumps a sequence number (in memory;
+  persisted with the next put/delete); a put that pushes the store past
+  `byte_budget` evicts lowest-seq entries first (never the entry just
+  written). Object files are refcounted by digest, so two keys sharing
+  identical bytes share one blob; blobs orphaned by a manifest reset or
+  writer race are swept at the next open.
+- Cross-process: readers reload the manifest from disk on a miss, so a
+  store populated by another process (warmup job, previous server run) is
+  visible without restart, and a plain hit never writes the manifest, so
+  readers cannot clobber a writer. Concurrent WRITERS are not coordinated
+  beyond atomic replacement — last manifest write wins; run one
+  warmup/serve writer per store (the intended deployment).
+
+Metrics (duck-typed `inc`/`gauge`, e.g. service.metrics.Metrics or its
+`scoped("store")` view): hits, misses, corrupt, evictions, put_bytes,
+and gauges bytes / entries.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("dpt.store")
+
+MANIFEST_VERSION = 1
+
+
+class _NullMetrics:
+    def inc(self, name, by=1):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+
+class ArtifactStore:
+    def __init__(self, root, byte_budget=None, metrics=None):
+        self.root = root
+        self.byte_budget = byte_budget
+        self.metrics = metrics or _NullMetrics()
+        self._lock = threading.Lock()
+        os.makedirs(os.path.join(root, "objects"), exist_ok=True)
+        self._manifest_path = os.path.join(root, "manifest.json")
+        self._manifest = self._load_manifest()
+        self._sweep_orphans()
+        self._publish_gauges()
+
+    # -- manifest -------------------------------------------------------------
+
+    def _load_manifest(self):
+        try:
+            with open(self._manifest_path) as f:
+                m = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {"version": MANIFEST_VERSION, "seq": 0, "entries": {}}
+        if m.get("version") != MANIFEST_VERSION:
+            # future/foreign manifest: start fresh rather than misparse.
+            # Blobs are content-addressed so orphans are harmless; the
+            # next open's _sweep_orphans reclaims the disk.
+            log.warning("store %s: manifest version %r != %d, resetting",
+                        self.root, m.get("version"), MANIFEST_VERSION)
+            return {"version": MANIFEST_VERSION, "seq": 0, "entries": {}}
+        return m
+
+    def _sweep_orphans(self):
+        """Delete object files no manifest entry references (left by a
+        manifest reset or a lost writer race) — they are invisible to the
+        byte budget, so without this they would grow the disk unbounded."""
+        live = {e["digest"] for e in self._manifest["entries"].values()}
+        objroot = os.path.join(self.root, "objects")
+        for sub in os.listdir(objroot):
+            subdir = os.path.join(objroot, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for fname in os.listdir(subdir):
+                digest = fname[:-4] if fname.endswith(".bin") else None
+                if digest in live:
+                    continue
+                path = os.path.join(subdir, fname)
+                try:  # stray tmp files from a crashed writer also land
+                    # here; an age floor keeps the sweep from racing a
+                    # concurrent put whose manifest write is in flight
+                    if time.time() - os.path.getmtime(path) > 300:
+                        os.remove(path)
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass
+
+    def _save_manifest(self):
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f)
+        os.replace(tmp, self._manifest_path)
+
+    def _publish_gauges(self):
+        ents = self._manifest["entries"]
+        self.metrics.gauge("bytes",
+                           sum(e["bytes"] for e in ents.values()))
+        self.metrics.gauge("entries", len(ents))
+
+    def _obj_path(self, digest):
+        return os.path.join(self.root, "objects", digest[:2], digest + ".bin")
+
+    def _next_seq(self):
+        self._manifest["seq"] += 1
+        return self._manifest["seq"]
+
+    # -- public API -----------------------------------------------------------
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._manifest["entries"])
+
+    def stats(self):
+        with self._lock:
+            ents = self._manifest["entries"]
+            return {"entries": len(ents),
+                    "bytes": sum(e["bytes"] for e in ents.values()),
+                    "byte_budget": self.byte_budget}
+
+    def meta(self, key):
+        with self._lock:
+            e = self._manifest["entries"].get(key)
+            return dict(e["meta"]) if e else None
+
+    def put(self, key, blob, meta=None):
+        """Store `blob` under `key` (replacing any prior entry), atomically.
+        Returns the content digest."""
+        digest = hashlib.sha256(blob).hexdigest()
+        path = self._obj_path(digest)
+        with self._lock:
+            if not os.path.exists(path):
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = path + ".tmp.%d" % os.getpid()
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, path)
+            old = self._manifest["entries"].get(key)
+            self._manifest["entries"][key] = {
+                "digest": digest, "bytes": len(blob),
+                "seq": self._next_seq(), "created": time.time(),
+                "meta": dict(meta or {}),
+            }
+            if old is not None and old["digest"] != digest:
+                self._drop_blob_if_unreferenced(old["digest"])
+            self.metrics.inc("put_bytes", len(blob))
+            self._evict_over_budget(protect=key)
+            self._save_manifest()
+            self._publish_gauges()
+        return digest
+
+    def get(self, key):
+        """Blob for `key`, or None (miss, or integrity failure — in which
+        case the corrupt entry is deleted so the caller's rebuild can
+        repopulate it)."""
+        with self._lock:
+            e = self._manifest["entries"].get(key)
+            if e is None:
+                # another process may have populated the store since we
+                # loaded the manifest (warmup job, previous server run)
+                self._manifest = self._load_manifest()
+                e = self._manifest["entries"].get(key)
+            if e is None:
+                self.metrics.inc("misses")
+                return None
+            blob = self._read_verified(key, e)
+            if blob is None:
+                self.metrics.inc("corrupt")
+                self._delete_locked(key)
+                self._save_manifest()
+                self._publish_gauges()
+                return None
+            self.metrics.inc("hits")
+            # LRU touch, in memory only: a hit must NOT rewrite the
+            # manifest — a reader that writes would clobber entries a
+            # concurrent warmup/serve writer just added (last-write-wins
+            # manifest). Recency is persisted by the next real write
+            # (put/delete), which is also when eviction reads it.
+            e["seq"] = self._next_seq()
+            return blob
+
+    def delete(self, key):
+        with self._lock:
+            if key in self._manifest["entries"]:
+                self._delete_locked(key)
+                self._save_manifest()
+                self._publish_gauges()
+                return True
+            return False
+
+    # -- internals (lock held) ------------------------------------------------
+
+    def _read_verified(self, key, e):
+        try:
+            with open(self._obj_path(e["digest"]), "rb") as f:
+                blob = f.read()
+        except OSError as err:
+            log.warning("store %s: %s unreadable (%s); dropping entry",
+                        self.root, key, err)
+            return None
+        if len(blob) != e["bytes"] or \
+                hashlib.sha256(blob).hexdigest() != e["digest"]:
+            log.warning("store %s: %s failed integrity check "
+                        "(%d bytes on disk, %d expected); dropping entry",
+                        self.root, key, len(blob), e["bytes"])
+            return None
+        return blob
+
+    def _delete_locked(self, key):
+        e = self._manifest["entries"].pop(key)
+        self._drop_blob_if_unreferenced(e["digest"])
+
+    def _drop_blob_if_unreferenced(self, digest):
+        if any(e["digest"] == digest
+               for e in self._manifest["entries"].values()):
+            return
+        try:
+            os.remove(self._obj_path(digest))
+        except OSError:
+            pass
+
+    def _evict_over_budget(self, protect=None):
+        if self.byte_budget is None:
+            return
+        ents = self._manifest["entries"]
+        total = sum(e["bytes"] for e in ents.values())
+        # oldest-use first; the just-written entry survives even when it is
+        # alone over budget (an empty store that can't hold its one artifact
+        # would defeat the cache entirely)
+        for key in sorted(ents, key=lambda k: ents[k]["seq"]):
+            if total <= self.byte_budget:
+                break
+            if key == protect:
+                continue
+            total -= ents[key]["bytes"]
+            self._delete_locked(key)
+            self.metrics.inc("evictions")

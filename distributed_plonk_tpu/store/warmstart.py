@@ -1,0 +1,94 @@
+"""Warm-start layer: store-owned compile cache + AOT shape warmup.
+
+Two cold-start costs dominate serving a new circuit shape (PAPER.md's
+prover pays both once per shape): trusted-setup/key construction and the
+XLA compilation of the prover's NTT/MSM stages. The artifact store
+(artifacts.py + keycache.py) removes the first across restarts; this
+module removes the second by (a) parking JAX's persistent compilation
+cache under the store root, so compiled stages live and die with the
+artifacts they serve, and (b) an AOT warmup entry point that pre-builds
+keys AND pre-lowers/compiles the prover stages for a shape before any
+job arrives (WARMUP wire tag, scripts/warmup.py).
+
+None of this imports jax at module scope: the proof service's default
+backend is the pure-host oracle and must stay importable (and testable)
+with no XLA present. jax only loads when a jax-capable backend is
+actually handed in, or `configure_jax_cache` is called.
+"""
+
+import os
+import time
+
+from . import keycache
+
+JAX_CACHE_SUBDIR = "jax_cache"
+
+
+def set_jax_cache_env(store_root):
+    """Point the (not-yet-imported) jax backend's persistent compile cache
+    under `store_root`, via the DPT_JAX_CACHE_DIR knob field_jax reads at
+    import. Env-only — safe to call from processes that never load jax.
+    An explicit user setting (either knob) wins."""
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        os.environ.setdefault(
+            "DPT_JAX_CACHE_DIR",
+            os.path.join(os.path.abspath(store_root), JAX_CACHE_SUBDIR))
+
+
+def configure_jax_cache(store_root, min_compile_secs=0.5):
+    """Repoint an already-imported jax at the store-owned compile cache
+    (machine-fingerprint partitioned). Imports jax; returns the cache dir
+    or None when this jax can't be wired.
+
+    Same precedence rule as set_jax_cache_env: an operator's explicit
+    JAX_COMPILATION_CACHE_DIR wins — otherwise an offline `warmup --aot`
+    would bake executables into a directory the (env-respecting) server
+    never reads, silently wasting the whole warmup pass."""
+    if "JAX_COMPILATION_CACHE_DIR" in os.environ:
+        return None
+    from ..backend import field_jax
+    return field_jax.configure_compile_cache(
+        os.path.join(os.path.abspath(store_root), JAX_CACHE_SUBDIR),
+        min_compile_secs=min_compile_secs)
+
+
+def aot_warmup(backend, domain_size, ck=None):
+    """Pre-lower/compile the prover stages for one shape's domain on a
+    backend that supports it (JaxBackend.warm_stages); the host oracle
+    has no compile step, so it reports `unsupported` and costs nothing."""
+    if backend is None or not hasattr(backend, "warm_stages"):
+        return {"aot": "unsupported",
+                "backend": getattr(backend, "name", None)}
+    t0 = time.monotonic()
+    report = backend.warm_stages(domain_size, ck=ck)
+    report["aot"] = "ok"
+    report["aot_s"] = round(time.monotonic() - t0, 3)
+    return report
+
+
+def warm_spec(store, spec_obj, backend=None, aot_backend=None):
+    """Offline store provisioning (scripts/warmup.py --store-dir): make
+    sure `store` holds the bucket keys for one wire spec, building them
+    only on a disk miss; `aot_backend` additionally precompiles the
+    shape's prover stages. Returns a summary dict ({source: disk|built})."""
+    from ..service import jobs as J
+
+    spec = J.JobSpec.from_wire(spec_obj)
+    key = J.shape_key(spec)
+    t0 = time.monotonic()
+    hit = keycache.load_bucket(store, key)
+    if hit is not None:
+        _srs, pk, vk, meta = hit
+        out = {"shape_key": [str(p) for p in key], "source": "disk",
+               "domain_size": vk.domain_size,
+               "load_s": round(time.monotonic() - t0, 6),
+               "build_s": meta.get("build_s")}
+    else:
+        srs, pk, vk = J.build_bucket_keys(spec, backend=backend)
+        build_s = time.monotonic() - t0
+        keycache.store_bucket(store, key, srs, pk, vk, build_s=build_s)
+        out = {"shape_key": [str(p) for p in key], "source": "built",
+               "domain_size": vk.domain_size, "build_s": round(build_s, 6)}
+    if aot_backend is not None:
+        out["aot"] = aot_warmup(aot_backend, vk.domain_size, ck=pk.ck)
+    return out

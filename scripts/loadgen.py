@@ -58,6 +58,10 @@ def main():
     ap.add_argument("--jobs", type=int, default=8)
     ap.add_argument("--workers", type=int, default=2,
                     help="pool size for the self-hosted server")
+    ap.add_argument("--store-dir", default=None,
+                    help="artifact store for the self-hosted server: run "
+                         "twice with the same dir and the second run's "
+                         "key_builds is 0 (warm start)")
     ap.add_argument("--no-kill", action="store_true")
     ap.add_argument("--kill-attempts", type=int, default=3,
                     help="re-tries if the kill races a finishing prove")
@@ -72,7 +76,8 @@ def main():
     port = args.port
     if host is None:
         svc = ProofService(port=0, prover_workers=args.workers, chaos=True,
-                           allow_remote_shutdown=True).start()
+                           allow_remote_shutdown=True,
+                           store_dir=args.store_dir).start()
         host, port = "127.0.0.1", svc.port
 
     key_cache, key_lock = {}, threading.Lock()
@@ -168,6 +173,10 @@ def main():
         "verified": verified,
         "failed": [r for r in results if not r.get("verified")],
         "kill": kill_report,
+        # key_builds == bucket_misses: 0 on a warm-store rerun of the same
+        # shape mix (the ISSUE-2 acceptance check; see --store-dir)
+        "key_builds": metrics["counters"].get("bucket_misses", 0),
+        "key_disk_hits": metrics["counters"].get("bucket_disk_hits", 0),
         "metrics": {
             "counters": metrics["counters"],
             "gauges": metrics["gauges"],

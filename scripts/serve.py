@@ -31,6 +31,14 @@ def main():
     ap.add_argument("--timeout", type=float, default=None,
                     help="per-job wall-clock budget, seconds")
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--store-dir", default=None,
+                    help="artifact store root: persists SRS/keys across "
+                         "restarts and parks the JAX compile cache; warm "
+                         "it ahead of time with scripts/warmup.py")
+    ap.add_argument("--store-budget", type=int, default=None,
+                    help="store byte budget (LRU eviction past it)")
+    ap.add_argument("--bucket-cap", type=int, default=64,
+                    help="max shape buckets resident in memory (LRU)")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--allow-remote-shutdown", action="store_true",
@@ -38,6 +46,12 @@ def main():
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.store_dir is not None:
+        # park the persistent compile cache under the store root BEFORE
+        # any jax backend import, so compiled prover stages warm-start
+        # alongside the keys they serve
+        from distributed_plonk_tpu.store import set_jax_cache_env
+        set_jax_cache_env(args.store_dir)
     from distributed_plonk_tpu.service import ProofService
 
     svc = ProofService(
@@ -46,9 +60,12 @@ def main():
         max_retries=args.retries, job_timeout_s=args.timeout,
         ckpt_dir=args.ckpt_dir, chaos=args.chaos,
         verify_on_complete=args.verify,
-        allow_remote_shutdown=args.allow_remote_shutdown).start()
+        allow_remote_shutdown=args.allow_remote_shutdown,
+        store_dir=args.store_dir, store_byte_budget=args.store_budget,
+        bucket_cap=args.bucket_cap).start()
     print(json.dumps({"listening": f"{svc.host}:{svc.port}",
-                      "workers": args.workers, "chaos": args.chaos}),
+                      "workers": args.workers, "chaos": args.chaos,
+                      "store": args.store_dir}),
           flush=True)
     try:
         svc.serve_forever()

@@ -118,6 +118,41 @@ def test_tcp_round_trip_and_bucket_reuse(service):
     assert m["histograms"]["prove_round/round1"]["count"] >= 3
 
 
+def test_warmup_over_wire(tmp_path):
+    svc = ProofService(port=0, prover_workers=1,
+                       store_dir=str(tmp_path / "store")).start()
+    try:
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            w1 = c.warmup(TOY_A)
+            assert w1["source"] == "built" and w1["build_s"] > 0
+            w2 = c.warmup(TOY_A)
+            assert w2["source"] == "memory"
+            # aot on the host-oracle pool backend: reported, not an error
+            assert c.warmup(TOY_A, aot=True)["aot"]["aot"] == "unsupported"
+            with pytest.raises(ServiceError, match="bad_spec"):
+                c.warmup({"kind": "toy", "gates": 0})
+            # a submit for the warmed shape never builds keys
+            jid = c.submit(dict(TOY_A, seed=4))["job_id"]
+            assert c.wait(jid, timeout_s=180)["state"] == "done"
+            m = c.metrics()
+        assert m["counters"]["warmups"] == 3
+        assert m["counters"]["bucket_misses"] == 1   # the warmup's build
+        assert m["counters"]["bucket_hits"] >= 3
+        assert m["counters"]["store_put_bytes"] > 0  # keys persisted
+    finally:
+        svc.shutdown()
+
+    # restarted service over the same store: WARMUP reports a disk hit
+    svc2 = ProofService(port=0, prover_workers=1,
+                        store_dir=str(tmp_path / "store")).start()
+    try:
+        with ServiceClient("127.0.0.1", svc2.port) as c:
+            assert c.warmup(TOY_A)["source"] == "disk"
+        assert svc2.metrics.snapshot()["counters"]["bucket_disk_hits"] == 1
+    finally:
+        svc2.shutdown()
+
+
 def test_tcp_errors(service):
     with ServiceClient("127.0.0.1", service.port) as c:
         with pytest.raises(ServiceError, match="bad_spec"):

@@ -1,0 +1,212 @@
+"""Artifact store + warm start: the contracts the serving path leans on.
+
+- bucket keys round-trip the store ELEMENT-IDENTICAL (proofs made with a
+  disk-loaded proving key are byte-equal to fresh-key proofs, so golden
+  fixtures and checkpoint fingerprints survive a server restart);
+- the store detects corrupted/truncated artifacts, deletes them, and the
+  cache falls through to a fresh build instead of crashing;
+- LRU byte-budget eviction removes least-recently-USED entries first;
+- a second BucketCache over the same store root (the restarted-server
+  case) serves previously seen shapes from disk without ever calling
+  build_bucket_keys;
+- the in-memory tier is bounded (entry cap + eviction counter).
+
+Pure host (tiny toy domains, no XLA) — runs in the fast host tier.
+"""
+
+import random
+
+import pytest
+
+from distributed_plonk_tpu.proof_io import deserialize_proof, serialize_proof
+from distributed_plonk_tpu.prover import prove
+from distributed_plonk_tpu.service.jobs import (JobSpec, build_bucket_keys,
+                                                build_circuit, shape_key)
+from distributed_plonk_tpu.service.metrics import Metrics
+from distributed_plonk_tpu.service.scheduler import BucketCache
+from distributed_plonk_tpu.service import scheduler as scheduler_mod
+from distributed_plonk_tpu.store import (ArtifactStore, bucket_store_key,
+                                         deserialize_bucket, load_bucket,
+                                         serialize_bucket, store_bucket)
+from distributed_plonk_tpu.backend.python_backend import PythonBackend
+from distributed_plonk_tpu.verifier import verify
+
+TOY = {"kind": "toy", "gates": 8}
+
+
+def _spec(seed=0, **over):
+    d = dict(TOY, seed=seed)
+    d.update(over)
+    return JobSpec.from_wire(d)
+
+
+@pytest.fixture(scope="module")
+def built():
+    """One shared key build for the module (the expensive part)."""
+    return build_bucket_keys(_spec())
+
+
+# --- serialization round trip ------------------------------------------------
+
+def test_bucket_roundtrip_element_identical(built):
+    srs, pk, vk = built
+    srs2, pk2, vk2 = deserialize_bucket(serialize_bucket(srs, pk, vk))
+    assert srs2.powers_of_g1 == srs.powers_of_g1
+    assert (srs2.g2, srs2.tau_g2) == (srs.g2, srs.tau_g2)
+    assert pk2.ck == pk.ck
+    assert pk2.selectors == pk.selectors and pk2.sigmas == pk.sigmas
+    assert pk2.domain.size == pk.domain.size
+    assert vk2.selector_comms == vk.selector_comms
+    assert vk2.sigma_comms == vk.sigma_comms
+    assert (vk2.domain_size, vk2.num_inputs, vk2.k) == \
+        (vk.domain_size, vk.num_inputs, vk.k)
+
+
+def test_proof_bytes_identical_with_loaded_keys(built, tmp_path):
+    srs, pk, vk = built
+    store = ArtifactStore(str(tmp_path))
+    key = shape_key(_spec())
+    store_bucket(store, key, srs, pk, vk, build_s=0.5)
+    _srs2, pk2, vk2, meta = load_bucket(store, key)
+    assert meta["build_s"] == 0.5
+
+    spec = _spec(seed=7)
+    want = serialize_proof(
+        prove(random.Random(7), build_circuit(spec), pk, PythonBackend()))
+    ckt = build_circuit(spec)
+    got = serialize_proof(
+        prove(random.Random(7), ckt, pk2, PythonBackend()))
+    assert got == want
+    assert verify(vk2, ckt.public_input(), deserialize_proof(got),
+                  rng=random.Random(1))
+
+
+# --- integrity: corruption detect-and-rebuild --------------------------------
+
+def _corrupt_object(store, key, mutate):
+    ent = store._manifest["entries"][bucket_store_key(key)]
+    path = store._obj_path(ent["digest"])
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(mutate(blob))
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[: len(b) // 2],                     # truncation
+    lambda b: b[:100] + bytes([b[100] ^ 0xFF]) + b[101:],  # bit damage
+], ids=["truncated", "flipped"])
+def test_corrupt_artifact_rebuilds(built, tmp_path, mutate):
+    srs, pk, vk = built
+    metrics = Metrics()
+    store = ArtifactStore(str(tmp_path), metrics=metrics.scoped("store"))
+    key = shape_key(_spec())
+    store_bucket(store, key, srs, pk, vk)
+    _corrupt_object(store, key, mutate)
+
+    # the store detects, logs, deletes — and reports a miss
+    assert load_bucket(store, key) is None
+    snap = metrics.snapshot()
+    assert snap["counters"]["store_corrupt"] == 1
+    assert bucket_store_key(key) not in store.keys()
+
+    # ... so the cache's build tier repopulates instead of crashing
+    cache = BucketCache(metrics, store=store)
+    res = cache.get(_spec())
+    assert res.vk.selector_comms == vk.selector_comms
+    snap = metrics.snapshot()
+    assert snap["counters"]["bucket_misses"] == 1
+    assert load_bucket(store, key) is not None  # healed on disk
+
+
+def test_undeserializable_blob_is_dropped(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    key = shape_key(_spec())
+    store.put(bucket_store_key(key), b"not a bucket blob at all")
+    assert load_bucket(store, key) is None  # parse fails -> treated as miss
+    assert store.keys() == []               # and the stale entry is gone
+
+
+# --- LRU byte-budget eviction ------------------------------------------------
+
+def test_eviction_least_recently_used_first(tmp_path):
+    metrics = Metrics()
+    store = ArtifactStore(str(tmp_path), byte_budget=250,
+                          metrics=metrics.scoped("store"))
+    for name in ("a", "b", "c"):
+        store.put(name, bytes(80), meta={"n": name})
+    assert store.keys() == ["a", "b", "c"]
+    assert store.get("a") is not None   # touch: a is now most recent
+    store.put("d", bytes(80))           # 320 > 250: evict LRU until under
+    assert store.keys() == ["a", "c", "d"]  # b (oldest-used) went first
+    snap = metrics.snapshot()
+    assert snap["counters"]["store_evictions"] == 1
+    assert snap["gauges"]["store_bytes"] == 240
+    store.put("e", bytes(200))          # forces out everything else but e
+    assert "e" in store.keys()
+    assert store.stats()["bytes"] <= 250
+
+
+def test_orphaned_blobs_swept_on_open(tmp_path):
+    import os
+    store = ArtifactStore(str(tmp_path))
+    store.put("k", b"payload")
+    path = store._obj_path(store._manifest["entries"]["k"]["digest"])
+    # simulate a manifest reset / lost writer race: entry gone, blob left
+    os.remove(store._manifest_path)
+    old = os.path.getmtime(path) - 3600
+    os.utime(path, (old, old))  # past the sweep's age floor
+    store2 = ArtifactStore(str(tmp_path))
+    assert store2.keys() == []
+    assert not os.path.exists(path)  # orphan reclaimed, budget stays honest
+
+
+def test_just_written_entry_survives_tiny_budget(tmp_path):
+    store = ArtifactStore(str(tmp_path), byte_budget=10)
+    store.put("big", bytes(100))
+    assert store.get("big") is not None  # never evict the entry just put
+
+
+# --- warm start across processes ---------------------------------------------
+
+def test_second_cache_instance_hits_disk_skips_build(tmp_path, monkeypatch):
+    m1 = Metrics()
+    cache1 = BucketCache(m1, store=ArtifactStore(str(tmp_path)))
+    res1 = cache1.get(_spec(seed=1))
+    assert m1.snapshot()["counters"]["bucket_misses"] == 1
+
+    # "restarted server": fresh store handle + fresh cache over the same
+    # root; a rebuild here would defeat the whole subsystem, so make any
+    # build attempt an error
+    def boom(spec, backend=None):
+        raise AssertionError("warm path called build_bucket_keys")
+
+    monkeypatch.setattr(scheduler_mod.J, "build_bucket_keys", boom)
+    m2 = Metrics()
+    cache2 = BucketCache(m2, store=ArtifactStore(str(tmp_path)))
+    res2 = cache2.get(_spec(seed=2))
+    snap = m2.snapshot()
+    assert snap["counters"]["bucket_disk_hits"] == 1
+    assert "bucket_misses" not in snap["counters"]
+    assert res2.vk.selector_comms == res1.vk.selector_comms
+    assert res2.pk.ck == res1.pk.ck
+
+    # memory tier on the second touch
+    cache2.get(_spec(seed=3))
+    assert m2.snapshot()["counters"]["bucket_hits"] == 1
+
+
+# --- bounded in-memory tier --------------------------------------------------
+
+def test_memory_tier_entry_cap_and_eviction_counter():
+    metrics = Metrics()
+    cache = BucketCache(metrics, max_entries=1)  # no store: build tier only
+    a, b = _spec(), _spec(gates=12)
+    cache.get(a)
+    cache.get(b)          # evicts a
+    cache.get(b)          # memory hit
+    cache.get(a)          # rebuilt (a was evicted)
+    snap = metrics.snapshot()
+    assert snap["counters"]["bucket_misses"] == 3
+    assert snap["counters"]["bucket_mem_evictions"] == 2
+    assert snap["counters"]["bucket_hits"] == 1
+    assert snap["gauges"]["buckets_resident"] == 1
