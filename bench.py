@@ -98,8 +98,50 @@ def host_ntt_seconds():
     return host_s
 
 
+def _ntt_stage_breakdown(plan, radix, reps=5):
+    """Per-stage wall-clock of the NTT core's component bodies at
+    (16, 1, n): lets a future MFU regression be pinned on a specific
+    stage (radix-4 scan body / radix-2 stage or fixup / output
+    bit-reversal gather) instead of just the end-to-end number."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.backend import ntt_jax as NJ
+
+    rng = np.random.default_rng(4)
+    v = jnp.asarray(rng.integers(0, 1 << 16, size=(16, 1, plan.n),
+                                 dtype=np.uint32))
+    pow_tab = jnp.asarray(plan.pow_fwd)
+
+    def timed(fn, *args):
+        out = fn(*args)
+        np.asarray(out[:, :, :1])  # compile + warm, then fence the loop
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        np.asarray(out[:, :, :1])
+        return round((time.perf_counter() - t0) / reps, 6)
+
+    out = {}
+    if radix == 4 and plan.exps4 is not None:
+        e = jnp.asarray(plan.exps4[plan.exps4.shape[0] // 2])
+        out["radix4_stage_s"] = timed(jax.jit(NJ._stage4), v, e, pow_tab)
+        out["radix4_stages"] = int(plan.exps4.shape[0])
+        if plan.fix_exps is not None:
+            out["fixup_stage_s"] = timed(
+                jax.jit(NJ._stage2), v, jnp.asarray(plan.fix_exps), pow_tab)
+    else:
+        e = jnp.asarray(plan.exps[plan.log_n // 2])
+        out["radix2_stage_s"] = timed(jax.jit(NJ._stage2), v, e, pow_tab)
+        out["radix2_stages"] = plan.log_n
+    out["output_perm_s"] = timed(
+        jax.jit(lambda a, p: a[:, :, p]), v, jnp.asarray(plan.perm))
+    return out
+
+
 def device_ntt_seconds():
-    """(single-poly seconds, per-poly seconds in a batch-8 launch)."""
+    """(single-poly seconds, per-poly seconds in a batch-8 launch, batch
+    width, radix/kernel-variant + per-stage metadata dict)."""
     import numpy as np
     from distributed_plonk_tpu.backend import ntt_jax
 
@@ -110,6 +152,7 @@ def device_ntt_seconds():
         # in-order, so syncing the last output fences the whole loop
         np.asarray(x[:, :1])
 
+    radix = ntt_jax._active_radix()
     plan = ntt_jax.get_plan(N)
     kernel = plan.kernel()  # Montgomery boundary: the device-resident hot path
     rng = np.random.default_rng(2)
@@ -131,7 +174,38 @@ def device_ntt_seconds():
         out = kb(vb)
     sync(out[:, 0])
     batch = (time.perf_counter() - t0) / reps / b
-    return single, batch, b
+
+    meta = {
+        "ntt_radix": radix,
+        "ntt_kernel_variant": ("radix4-fused-twiddle"
+                               if radix == 4 and plan.exps4 is not None
+                               else "radix2-pease"),
+    }
+    # diagnostics scale their rep count to the measured kernel time so a
+    # slow platform (CPU fallback) doesn't burn the inner budget on them
+    diag_reps = reps if single < 2.0 else 1
+    try:
+        # in-run A/B against the other radix (same chip, same arrays):
+        # makes the radix speedup attributable without a second bench run
+        other = 2 if radix == 4 else 4
+        ko = plan.kernel(radix=other)
+        sync(ko(v))
+        t0 = time.perf_counter()
+        for _ in range(diag_reps):
+            out = ko(v)
+        sync(out)
+        other_s = (time.perf_counter() - t0) / diag_reps
+        meta[f"ntt_2p{LOG_N}_radix{other}_device_s"] = round(other_s, 5)
+        r4, r2 = (single, other_s) if radix == 4 else (other_s, single)
+        meta["ntt_radix4_speedup_vs_radix2"] = round(r2 / r4, 2)
+    except Exception as e:  # diagnostic only; never fail the bench line
+        meta["ntt_ab_error"] = repr(e)
+    try:
+        meta["ntt_stage_breakdown"] = _ntt_stage_breakdown(
+            plan, radix, reps=diag_reps)
+    except Exception as e:
+        meta["ntt_stage_breakdown_error"] = repr(e)
+    return single, batch, b, meta
 
 
 def device_msm_seconds():
@@ -267,7 +341,8 @@ def host_prove_seconds():
 def inner_main():
     """The actual measurement (runs in a budgeted subprocess)."""
     extra = {}
-    ntt_dev, ntt_batch, nb = device_ntt_seconds()
+    ntt_dev, ntt_batch, nb, ntt_meta = device_ntt_seconds()
+    extra.update(ntt_meta)
     extra[f"ntt_2p{LOG_N}_elements_per_s"] = round(N / ntt_dev)
     extra[f"ntt_2p{LOG_N}_device_s"] = round(ntt_dev, 5)
     extra[f"ntt_2p{LOG_N}_batch{nb}_per_poly_s"] = round(ntt_batch, 5)
@@ -465,6 +540,10 @@ def _degraded(reason, extra=None):
     if cpu:
         out["cpu_ntt_2p14_device_s"] = cpu.get("ntt_2p14_device_s")
         out["cpu_ntt_2p14_elements_per_s"] = cpu.get("ntt_2p14_elements_per_s")
+        for k in ("ntt_radix", "ntt_kernel_variant",
+                  "ntt_radix4_speedup_vs_radix2", "ntt_stage_breakdown"):
+            if k in cpu and k not in out:
+                out[k] = cpu[k]
     if extra:
         out.update(extra)
     print(json.dumps(out))

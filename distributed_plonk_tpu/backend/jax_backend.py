@@ -191,10 +191,11 @@ class JaxBackend:
         of this shape launches, prover.py:59), at both single-poly and the
         batch widths _kernel_batches would pick — so the executables are
         in the persistent compile cache before the first job lands. With
-        `ck`, also builds the commit key's MsmContext and runs one
-        zero-scalar MSM through it (the MSM pipeline's compile is driven
-        by execution, not AOT lowering — a zero MSM costs one bucket-scan
-        pass and bakes the same executable a real commitment needs)."""
+        `ck`, also builds the commit key's MsmContext and AOT-lowers its
+        commitment pipeline (`MsmContext.aot_compile`) at the prover's
+        commit-batch widths — the wire batch (NUM_WIRE_TYPES), the
+        opening pair, and single commits; an ancient jax with no AOT API
+        falls back to the old one-zero-scalar execution pass."""
         from ..poly import Domain
         report = {"ntt": {}}
         quot = Domain((NUM_WIRE_TYPES + 1) * (domain_size + 1) + 1)
@@ -204,7 +205,20 @@ class JaxBackend:
             report["ntt"][dom_n] = ntt_jax.get_plan(dom_n).aot_compile(
                 batch_sizes=(chunk,) if chunk > 1 else ())
         if ck is not None:
-            self._ctx(ck).msm([0])
+            ctx = self._ctx(ck)
+            # digit widths = the blinded coefficient-handle widths the
+            # prover actually commits: wires/quotient-splits/openings are
+            # n+2 wide, the permutation poly n+3 (prover.py rounds 1-5)
+            msm_report = ctx.aot_compile(
+                batch_sizes=(1, 2, NUM_WIRE_TYPES),
+                digit_widths=(domain_size + 2, domain_size + 3))
+            if msm_report["failed"]:  # pragma: no cover - no/partial-AOT
+                # ANY stage that failed to lower would pay its compile on
+                # the first real job: keep the old warm-by-execution
+                # guarantee (one zero-scalar MSM bakes the whole pipeline)
+                ctx.msm([0])
+                msm_report["fallback_exec"] = True
+            report["msm"] = msm_report
             report["msm_warmed"] = True
         return report
 

@@ -735,6 +735,60 @@ class MsmContext:
             i0 += nc
         return self._finish_fn(B)(*acc)
 
+    def aot_compile(self, batch_sizes=(1,), digit_widths=None):
+        """Ahead-of-time `lower().compile()` of the commitment pipeline for
+        this key at the given batch widths: on-device digit extraction, the
+        per-chunk bucket-accumulation scan, the cross-chunk plane merge,
+        and the finish tail — no execution (`JaxBackend.warm_stages` used
+        to warm this path by RUNNING one zero-scalar MSM, which baked only
+        one shape and cost a real bucket-scan pass). Executables land in
+        the persistent compilation cache like the NTT AOT path.
+
+        Chunk/finish/merge shapes match a COLD context's first calls (the
+        adaptive chunk budget resizes once the adds/s calibration latches,
+        so post-calibration chunk shapes still compile at runtime; warmup's
+        job is the cold start, where compile time dominates). Digit
+        extraction jit-caches per EXACT handle width, so `digit_widths`
+        must be the coefficient-handle widths the caller will commit
+        (`warm_stages` passes the prover's n+2/n+3 blinded widths);
+        default: this key's full padded width.
+        Returns {"compiled", "failed", "shapes"}."""
+        compiled = failed = 0
+        shapes = []
+        u32 = jnp.uint32
+
+        def aot(fn, *specs):
+            nonlocal compiled, failed
+            try:
+                fn.lower(*specs).compile()
+                compiled += 1
+            except Exception:  # pragma: no cover - older jax without AOT
+                failed += 1
+
+        W = -(-SCALAR_BITS // self.c_batch)
+        c = -(-SCALAR_BITS // W)
+        buckets = 1 << (c - 1) if self.signed else 1 << c
+        if digit_widths is None:
+            digit_widths = (self.padded_n,)
+        for L in sorted({min(w, self.padded_n) for w in digit_widths}):
+            aot(self._digits_batch_fn,
+                jax.ShapeDtypeStruct((FR_LIMBS, L), u32))
+        for B in sorted(set(batch_sizes)):
+            nc = min(self._chunk_lanes(B, W), self.padded_n)
+            g = _group_size_batch(nc, B, c, signed=self.signed)
+            aot(self._chunk_fn(nc, g),
+                jax.ShapeDtypeStruct((FQ_LIMBS, nc), u32),
+                jax.ShapeDtypeStruct((FQ_LIMBS, nc), u32),
+                jax.ShapeDtypeStruct((nc,), jnp.bool_),
+                jax.ShapeDtypeStruct((B, W, nc), u32))
+            planes = tuple(
+                jax.ShapeDtypeStruct((FQ_LIMBS, B * W, buckets), u32)
+                for _ in range(3))
+            aot(self._finish_fn(B), *planes)
+            aot(self._merge_fn, planes, planes)
+            shapes.append({"batch": B, "chunk": nc, "group": g})
+        return {"compiled": compiled, "failed": failed, "shapes": shapes}
+
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
         assert len(scalars) <= self.n

@@ -102,8 +102,10 @@ class MeshNttPlan:
         self.post_plain = ntt_jax._mont_table([n_inv])  # (16, 1)
 
     def kernel(self, inverse=False, coset=False, boundary="mont"):
-        """Compiled (16, n) -> (16, n) mesh program for one mode."""
-        key = (inverse, coset, boundary)
+        """Compiled (16, n) -> (16, n) mesh program for one mode (at the
+        active DPT_NTT_RADIX — part of the cache key, like the
+        single-device kernels)."""
+        key = (inverse, coset, boundary, ntt_jax._active_radix())
         if key in self._fns:
             fn, consts = self._fns[key]
             return lambda v: fn(v, consts)
@@ -114,13 +116,12 @@ class MeshNttPlan:
 
         # host numpy constants: jit moves them onto the mesh's devices (which
         # may not be the process default backend, e.g. cpu mesh + tpu default)
+        # — the row/column stage tables come from the SAME shared stage core
+        # the single-device kernels run (ntt_jax.run_stages), so the active
+        # radix (DPT_NTT_RADIX) covers the sharded path too
         consts = {
-            "perm_r": self.plan_r.perm,
-            "exps_r": self.plan_r.exps,
-            "pow_r": self.plan_r.pow_inv if inverse else self.plan_r.pow_fwd,
-            "perm_c": self.plan_c.perm,
-            "exps_c": self.plan_c.exps,
-            "pow_c": self.plan_c.pow_inv if inverse else self.plan_c.pow_fwd,
+            "core_r": self.plan_r.core_consts(inverse),
+            "core_c": self.plan_c.core_consts(inverse),
             "mid": self.mid_inv if inverse else self.mid_fwd,
         }
         if coset and not inverse:
@@ -129,9 +130,13 @@ class MeshNttPlan:
             consts["post"] = (self.post_coset if coset else self.post_plain)
 
         row_spec = P(None, SHARD_AXIS, None)
+        # every stage-core table is replicated (O(n) twiddles/exponents,
+        # no per-shard content), whatever the radix's table set is
         const_specs = {
-            "perm_r": P(None), "exps_r": P(None, None), "pow_r": P(None, None),
-            "perm_c": P(None), "exps_c": P(None, None), "pow_c": P(None, None),
+            "core_r": {k: P(*([None] * np.ndim(a)))
+                       for k, a in consts["core_r"].items()},
+            "core_c": {k: P(*([None] * np.ndim(a)))
+                       for k, a in consts["core_c"].items()},
             "mid": row_spec,
         }
         if "pre" in consts:
@@ -144,15 +149,13 @@ class MeshNttPlan:
             # a: (16, c/d, r) local rows of A
             if "pre" in cs:
                 a = FJ.mont_mul(FR, a, cs["pre"])
-            v = ntt_jax.batched_butterflies(
-                a, cs["perm_r"], cs["exps_r"], cs["pow_r"])
+            v = ntt_jax.run_stages(a, cs["core_r"])
             v = FJ.mont_mul(FR, v, cs["mid"])
             # the ONE inter-stage transpose: (16, c/d, r) -> (16, c, r/d)
             v = lax.all_to_all(v, SHARD_AXIS, split_axis=2, concat_axis=1,
                                tiled=True)
             v = v.swapaxes(1, 2)  # local transpose -> (16, r/d, c)
-            v = ntt_jax.batched_butterflies(
-                v, cs["perm_c"], cs["exps_c"], cs["pow_c"])
+            v = ntt_jax.run_stages(v, cs["core_c"])
             if "post" in cs:
                 post = cs["post"]
                 if post.ndim == 2:  # plain 1/n scalar, broadcast symbolically

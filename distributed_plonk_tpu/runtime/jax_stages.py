@@ -34,14 +34,16 @@ class StageKernels:
 
     @staticmethod
     @jax.jit
-    def _panel_fn(v, pre, mid, post, perm, exps, pw):
+    def _panel_fn(v, pre, mid, post, core):
         """(16, B, L) canonical panel -> staged canonical panel. pre/mid/
         post are optional Montgomery scale tables (None-ness is static per
-        trace)."""
+        trace); core is a shared stage-core table set
+        (ntt_jax.NttPlan.core_consts), so the fleet panels run the same
+        radix-selected butterflies as the single-device kernels."""
         v = FJ.to_mont(FR, v)
         if pre is not None:
             v = FJ.mont_mul(FR, v, pre)
-        v = ntt_jax.batched_butterflies(v, perm, exps, pw)
+        v = ntt_jax.run_stages(v, core)
         if mid is not None:
             v = FJ.mont_mul(FR, v, mid)
         if post is not None:
@@ -49,13 +51,12 @@ class StageKernels:
         return FJ.from_mont(FR, v)
 
     def _plan_consts(self, size, inverse):
-        key = ("plan", size, inverse)
+        key = ("plan", size, inverse, ntt_jax._active_radix())
         if key not in self._tables:
             plan = ntt_jax.get_plan(size)
-            self._tables[key] = tuple(
-                jnp.asarray(t) for t in
-                (plan.perm, plan.exps,
-                 plan.pow_inv if inverse else plan.pow_fwd))
+            self._tables[key] = {
+                k: jnp.asarray(a)
+                for k, a in plan.core_consts(inverse).items()}
         return self._tables[key]
 
     def _cache_put(self, key, value):
@@ -83,7 +84,7 @@ class StageKernels:
             pre = ntt_jax._mont_table(vals).reshape(16, re - rs, r)
         w = fr_root_of_unity(n)
         base = fr_inv(w) if task.inverse else w
-        # batched_butterflies omits the 1/size factor of an iNTT: fold the
+        # the stage core (run_stages) omits the 1/size factor of an iNTT: fold the
         # stage-1 1/r into the mid twiddles (the int path's backend.ifft
         # applies it internally)
         start0 = fr_inv(r % R_MOD) if task.inverse else 1
@@ -122,14 +123,14 @@ class StageKernels:
         staged panel (numpy)."""
         b = panel.shape[1]
         pre, mid = self._stage1_tables(task, first_row, first_row + b)
-        perm, exps, pw = self._plan_consts(task.r, task.inverse)
-        out = self._panel_fn(panel, pre, mid, None, perm, exps, pw)
+        core = self._plan_consts(task.r, task.inverse)
+        out = self._panel_fn(panel, pre, mid, None, core)
         return np.asarray(out)
 
     def stage2_panel(self, task, cols_panel):
         """(16, locals, c) canonical columns panel -> staged output panel
         (numpy), ready for the wire."""
         post = self._stage2_tables(task, task.cs, task.ce)
-        perm, exps, pw = self._plan_consts(task.c, task.inverse)
-        out = self._panel_fn(cols_panel, None, None, post, perm, exps, pw)
+        core = self._plan_consts(task.c, task.inverse)
+        out = self._panel_fn(cols_panel, None, None, post, core)
         return np.asarray(out)

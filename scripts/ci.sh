@@ -2,6 +2,18 @@
 # Tier-1 verify: THE gate every PR must keep green (ROADMAP.md).
 # This wrapper is the single CI entry point — it runs the ROADMAP's
 # tier-1 command verbatim, so local runs, CI, and the driver all measure
-# the identical surface. Usage: scripts/ci.sh
+# the identical surface.
+#
+# Usage:
+#   scripts/ci.sh        full tier-1 (the ROADMAP command, wall-clock budgeted)
+#   scripts/ci.sh fast   kernel-parity subset: NTT + MSM oracle/radix tests
+#                        only — the quick pre-commit check for kernel work
+#                        (~6 min of XLA-CPU compiles, no prover/mesh/service)
 cd "$(dirname "$0")/.."
+if [ "$1" = "fast" ]; then
+  exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_ntt_jax.py tests/test_curve_msm_jax.py \
+    tests/test_msm_update_paths.py tests/test_poly.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -60,6 +60,29 @@ def test_msm_short_scalars_and_reuse():
     assert ctx.msm(s2) == C.g1_msm(bases, s2)
 
 
+def test_msm_aot_compile_then_correct():
+    """warm_stages' true AOT path: lower().compile() every pipeline stage
+    without executing anything — digit extraction at the COMMIT-handle
+    widths (it jit-caches per exact width; warm_stages passes n+2/n+3),
+    then verify a real Montgomery-handle commit and a scalar MSM still
+    match the oracle."""
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.backend.limbs import ints_to_limbs
+    from distributed_plonk_tpu.constants import FR_MONT_R
+
+    bases = _rand_points(32)
+    ctx = msm_jax.MsmContext(bases)
+    report = ctx.aot_compile(batch_sizes=(1, 2), digit_widths=(20, 32))
+    # 2x digit extraction + 2x (chunk scan, finish, merge)
+    assert report["compiled"] == 8 and report["failed"] == 0, report
+    assert [s["batch"] for s in report["shapes"]] == [1, 2]
+    scalars = [RNG.randrange(R_MOD) for _ in range(32)]
+    assert ctx.msm(scalars) == C.g1_msm(bases, scalars)
+    h = jnp.asarray(ints_to_limbs(
+        [s * FR_MONT_R % R_MOD for s in scalars[:20]], 16))  # warmed width
+    assert ctx.msm_mont_limbs(h) == C.g1_msm(bases[:20], scalars[:20])
+
+
 def _proj_to_affine_list(p3):
     """Per-column decode via the production converter (no re-implementation
     of the Montgomery/Z-inversion logic)."""

@@ -8,6 +8,8 @@ bit-identical to the host-oracle proof (same rng) and verify.
 
 import random
 
+import pytest
+
 from distributed_plonk_tpu.prover import prove
 from distributed_plonk_tpu.verifier import verify
 from distributed_plonk_tpu.backend.jax_backend import JaxBackend
@@ -36,3 +38,17 @@ def test_jax_prove_verifies_and_matches_oracle(proven):
     assert proof_dev.wires_evals == proof_host.wires_evals
     assert proof_dev.wire_sigma_evals == proof_host.wire_sigma_evals
     assert proof_dev.perm_next_eval == proof_host.perm_next_eval
+
+
+@pytest.mark.slow
+def test_jax_prove_radix2_byte_identical(proven, monkeypatch):
+    """DPT_NTT_RADIX=2 (the parity/debug core) produces the SAME proof
+    bytes as the host oracle — and therefore as the default radix-4
+    prove above. Slow tier: a second full set of prover-kernel compiles."""
+    from distributed_plonk_tpu import proof_io
+
+    ckt, pk, vk, proof_host = proven
+    monkeypatch.setenv("DPT_NTT_RADIX", "2")
+    proof_r2 = prove(random.Random(1), ckt, pk, JaxBackend())
+    assert (proof_io.serialize_proof(proof_r2)
+            == proof_io.serialize_proof(proof_host))

@@ -53,6 +53,19 @@ def test_mesh_ntt_matches_oracle(plan256, inverse, coset):
     assert got == _oracle(domain, values, inverse, coset)
 
 
+def test_mesh_ntt_radix2_core_parity(mesh8, plan256, monkeypatch):
+    """The mesh 4-step NTT runs its row/column butterflies through the
+    SHARED stage core (ntt_jax.run_stages): flipping DPT_NTT_RADIX=2
+    must reproduce the default radix-4 mesh result bit for bit."""
+    values = [RNG.randrange(R_MOD) for _ in range(plan256.n)]
+    want = plan256.run_ints(values)
+    monkeypatch.setenv("DPT_NTT_RADIX", "2")
+    got = plan256.run_ints(values)
+    assert got == want
+    assert (False, False, "plain", 2) in plan256._fns
+    assert (False, False, "plain", 4) in plan256._fns
+
+
 def test_mesh_ntt_roundtrip_uneven_rc(mesh8):
     # n = 512: r = 16, c = 32 (r != c exercises the all_to_all shapes)
     plan = MeshNttPlan(mesh8, 512)
