@@ -549,6 +549,28 @@ def _degraded(reason, extra=None):
     print(json.dumps(out))
 
 
+def _measure_analysis_clean():
+    """Run the static verifier (`ci.sh analyze` surface) in a scrubbed
+    CPU subprocess; returns {analysis_clean: bool} (+ detail on failure)
+    so every trajectory line records whether this tree still PROVES its
+    kernel bounds/lints — a perf number from an unverified tree is
+    flagged by construction. Never fails the bench."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "distributed_plonk_tpu.analysis",
+             "--strict", "-q"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True,
+            text=True,
+            timeout=int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")))
+        out = {"analysis_clean": proc.returncode == 0}
+        if proc.returncode != 0:
+            tail = (proc.stdout or proc.stderr or "").strip().splitlines()
+            out["analysis_detail"] = "; ".join(tail[-3:])[-400:]
+        return out
+    except Exception as e:
+        return {"analysis_clean": False, "analysis_detail": repr(e)}
+
+
 def _measure_service_roundtrip():
     """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
     keys, or {service_error} — the bench line never fails on it."""
@@ -584,15 +606,29 @@ def main():
     # (or its whole timeout when the service breaks) onto every run
     import threading
     svc_box = {}
-    svc_thread = threading.Thread(
-        target=lambda: svc_box.update(_measure_service_roundtrip()),
-        daemon=True)
+
+    def _side_measurements():
+        # SEQUENTIAL within the side thread: the analysis subprocess is
+        # ~70 s of CPU-bound tracing and must not contend with the TIMED
+        # service cold/warm round-trips; both still overlap the device
+        # measurement
+        svc_box.update(_measure_service_roundtrip())
+        svc_box.update(_measure_analysis_clean())
+
+    svc_thread = threading.Thread(target=_side_measurements, daemon=True)
     svc_thread.start()
 
     def svc():
         svc_thread.join(
-            timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300")) + 30)
-        return svc_box or {"service_error": "service roundtrip did not finish"}
+            timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300"))
+            + int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")) + 30)
+        out = dict(svc_box)
+        if not any(k.startswith("service") for k in out):
+            out["service_error"] = "service roundtrip did not finish"
+        if "analysis_clean" not in out:
+            out["analysis_clean"] = False
+            out["analysis_detail"] = "did not finish"
+        return out
 
     probe_t = int(os.environ.get("DPT_BENCH_PROBE_TIMEOUT", "150"))
     budget = int(os.environ.get("DPT_BENCH_TIMEOUT", "3000"))

@@ -1,0 +1,21 @@
+"""Static verifier for the kernel + serving stack.
+
+Two passes (see ISSUE/README "Static analysis"):
+
+- `bounds`: jaxpr abstract interpretation — per-value integer magnitude
+  intervals over every registered production kernel, proving no-u32-
+  overflow, float exactness, and dtype discipline; plus the
+  machine-checked zero-carry contracts (field_jax.CARRY_CONTRACTS).
+- `lint`: AST-level repo hazard lints — jit-cache keys, Python-scalar /
+  float promotion into traced code, lock discipline in service/+store/.
+
+`python -m distributed_plonk_tpu.analysis --strict` runs everything and
+exits nonzero on any violation; `scripts/ci.sh analyze` wraps it.
+Suppress a deliberate finding with `# analysis: ok(<reason>)` on (or
+directly above) the flagged line.
+"""
+
+from . import bounds, lint, registry  # noqa: F401
+from .bounds import Bound, check_fn, check_contracts, limb_rows  # noqa: F401
+from .lint import run_lints, lint_source  # noqa: F401
+from .registry import build_registry, run_bounds  # noqa: F401

@@ -1,0 +1,106 @@
+"""CLI: `python -m distributed_plonk_tpu.analysis [--strict] [...]`.
+
+Exit status 0 iff every selected pass is clean — the one-command proof
+obligation `scripts/ci.sh analyze` runs and bench.py records as
+`analysis_clean`. Runs on CPU (tracing only, nothing executes on a
+device), so it is safe anywhere the repo imports.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_plonk_tpu.analysis",
+        description="static kernel verifier: jaxpr interval bounds, "
+                    "carry contracts, and AST hazard lints")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat unhandled primitives / warnings as errors")
+    ap.add_argument("--only", choices=("bounds", "lint", "contracts"),
+                    help="run a single pass (default: all)")
+    ap.add_argument("--kernel", action="append",
+                    help="substring filter on registry entry names "
+                         "(repeatable; bounds pass only)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registry entries and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only print failures and the summary line")
+    args = ap.parse_args(argv)
+
+    # tracing must not wait on (or disturb) an accelerator runtime; the
+    # env var only takes effect when jax has not been imported yet, which
+    # is the normal `python -m` path
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.list:
+        # enumeration only: no passes run, nothing else interleaves
+        from .registry import build_registry
+        for e in build_registry():
+            print(e.name)
+        return 0
+
+    failures = 0
+    t0 = time.monotonic()
+
+    if args.only in (None, "lint"):
+        from .lint import run_lints
+        findings = run_lints()
+        for f in findings:
+            print(f"LINT FAIL {f}")
+        if not args.quiet:
+            print(f"lint: {len(findings)} finding(s)")
+        failures += len(findings)
+
+    if args.only in (None, "contracts"):
+        from .bounds import check_contracts
+        bad = check_contracts()
+        for v in bad:
+            print(f"CONTRACT FAIL {v}")
+        if not args.quiet:
+            from ..backend.field_jax import CARRY_CONTRACTS
+            print(f"contracts: {len(CARRY_CONTRACTS)} checked for "
+                  f"Fr+Fq, {len(bad)} violated")
+        failures += len(bad)
+
+    if args.only in (None, "bounds"):
+        from .registry import run_bounds
+
+        checked_box = [0]
+
+        def progress(name, violations):
+            checked_box[0] += 1
+            if violations:
+                print(f"BOUNDS FAIL {name}: "
+                      f"{len(violations)} violation(s)")
+                for v in violations:
+                    print(f"  {v}")
+            elif not args.quiet:
+                print(f"ok {name}")
+
+        # when the contracts pass already ran above, don't double-run
+        # (or double-count) it here; under --only bounds the contracts
+        # still run and COUNT — a violated contract must never print
+        # CLEAN just because the pass selection filtered it
+        contracts_here = args.only == "bounds"
+        violations, _ = run_bounds(strict=args.strict, names=args.kernel,
+                                   progress=progress,
+                                   contracts=contracts_here)
+        for v in violations:
+            if v.kernel.startswith("contract/"):
+                print(f"CONTRACT FAIL {v}")
+        if not args.quiet:
+            print(f"bounds: {checked_box[0]} kernel(s) checked, "
+                  f"{len(violations)} violation(s)")
+        failures += len(violations)
+
+    dt = time.monotonic() - t0
+    verdict = "CLEAN" if failures == 0 else f"{failures} FAILURE(S)"
+    print(f"analysis: {verdict} in {dt:.1f}s")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

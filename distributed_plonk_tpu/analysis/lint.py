@@ -1,0 +1,502 @@
+"""AST-level repo hazard lints (the sub-second half of the verifier).
+
+Three lint families, each targeting a bug class this repo has actually
+shipped or nearly shipped:
+
+JIT01 jit-cache-key: a jit-compiled callable is stored in a cache dict
+    (`self._fns[key] = jax.jit(...)` / `= (fn, consts)`) but the
+    closure/partial it wraps depends on an enclosing-function local that
+    is NOT derivable from the cache key — so two call sites that differ
+    in that value silently share (or miss) a compiled program. This is
+    the PR 3 bug class (digit extraction caching per exact width while
+    warmup compiled another). Derivability is tracked through simple
+    local assignments (`plain = boundary == "plain"` makes `plain`
+    key-derived when `boundary` is in the key); `self` and module
+    globals are allowed (per-instance caches are keyed by identity,
+    globals are latched configuration).
+
+PROM01/PROM02 dtype promotion: arithmetic in a kernel module mixing a
+    bare Python float literal into (potentially traced) expressions —
+    jnp promotes uint32 arrays to f32 silently — and any float64
+    reference in kernel modules (the limb pipeline is 32-bit end to
+    end).
+
+LOCK01/LOCK02 lock discipline (service/ + store/): a self attribute of
+    a class that owns a threading lock is mutated both inside and
+    outside `with self._lock` scopes (LOCK01), or mutated outside the
+    lock while another method READS it under the lock (LOCK02) —
+    outside __init__ in both cases. Helper methods whose intra-class
+    call sites are ALL lock-held count as lock-held themselves
+    (fixpoint), so `_delete_locked`-style internals don't
+    false-positive.
+
+Suppression: append `# analysis: ok(<reason>)` to the flagged line (or
+the line above) — deliberate exceptions stay visible and reasoned at
+the site. Pragmas are honored by every lint.
+"""
+
+import ast
+import os
+import re
+
+PRAGMA_RE = re.compile(r"#\s*analysis:\s*ok\(([^)]*)\)")
+
+_REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
+_PKG = os.path.join(_REPO, "distributed_plonk_tpu")
+
+# modules whose code is (or stages) traced kernels: the promotion and
+# jit-cache lints run here
+KERNEL_DIRS = ("backend", "parallel", "runtime")
+# modules with cross-thread shared state: the lock lint runs here
+LOCK_DIRS = ("service", "store")
+
+# mutating container-method names treated as writes by LOCK01 (calls on
+# self.<attr>.<name>(...)); read-only or thread-safe APIs (queue.put,
+# event.set) are deliberately absent
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "setdefault", "move_to_end", "sort",
+             "add", "discard"}
+
+
+class Finding:
+    def __init__(self, path, line, code, message):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.message = message
+
+    def __str__(self):
+        rel = os.path.relpath(self.path, _REPO)
+        return f"{rel}:{self.line}: {self.code}: {self.message}"
+
+
+def _pragma_lines(src):
+    """Line numbers (1-based) carrying an `# analysis: ok(...)` pragma."""
+    out = set()
+    for i, line in enumerate(src.splitlines(), start=1):
+        if PRAGMA_RE.search(line):
+            out.add(i)
+    return out
+
+
+def _suppressed(pragmas, line):
+    return line in pragmas or (line - 1) in pragmas
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _self_attr(node):
+    """'self.x' -> 'x' (walking through subscripts: self.x[k] -> 'x')."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# --- JIT01: jit cache keys ----------------------------------------------------
+
+def _is_jit_call(node):
+    """`jax.jit(...)` / `jit(...)` call expression."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return ((isinstance(f, ast.Attribute) and f.attr == "jit")
+            or (isinstance(f, ast.Name) and f.id == "jit"))
+
+
+def _has_jit_decorator(fdef):
+    for d in fdef.decorator_list:
+        if (isinstance(d, ast.Attribute) and d.attr == "jit") \
+                or (isinstance(d, ast.Name) and d.id == "jit") \
+                or (isinstance(d, ast.Call) and _is_jit_call(d)):
+            return True
+    return False
+
+
+def _local_deps(fn):
+    """name -> set(names it was computed from), for simple assignments
+    directly in `fn`'s body (no control-flow sensitivity — enough to
+    track `plain = boundary == "plain"` style derivations)."""
+    deps = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            deps[node.targets[0].id] = _names_in(node.value)
+    return deps
+
+
+def _transitive(names, deps, limit=32):
+    out = set(names)
+    for _ in range(limit):
+        grew = False
+        for n in list(out):
+            for d in deps.get(n, ()):
+                if d not in out:
+                    out.add(d)
+                    grew = True
+        if not grew:
+            break
+    return out
+
+
+def _closure_free_names(value, fn, jit_defs):
+    """Names the cached value's compiled behavior depends on: names in
+    jit(...) call arguments, plus — when the value references a local
+    function that carries @jit — that function's body free names."""
+    names = set()
+    for node in ast.walk(value):
+        if _is_jit_call(node):
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                names |= _names_in(arg)
+        elif isinstance(node, ast.Name) and node.id in jit_defs:
+            names |= jit_defs[node.id]
+    return names
+
+
+def _jit_def_free_names(fdef):
+    """Free names of a nested @jit function: names read in its body that
+    are not its own params/locals."""
+    bound = {a.arg for a in (fdef.args.args + fdef.args.kwonlyargs
+                             + fdef.args.posonlyargs)}
+    if fdef.args.vararg:
+        bound.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        bound.add(fdef.args.kwarg.arg)
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    free = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id not in bound:
+            free.add(node.id)
+    return free
+
+
+def _lint_jit_cache(tree, path, src, module_names, findings):
+    pragmas = _pragma_lines(src)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                  + fn.args.posonlyargs}
+        deps = _local_deps(fn)
+        jit_defs = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn \
+                    and _has_jit_decorator(node):
+                jit_defs[node.name] = _jit_def_free_names(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_jit_call(node.value):
+                jit_defs[node.targets[0].id] = _names_in(node.value)
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Subscript)):
+                continue
+            target = node.targets[0]
+            # only cache DICTS survive across calls: self.<x>[key] = ...
+            if _self_attr(target) is None:
+                continue
+            closure = _closure_free_names(node.value, fn, jit_defs)
+            if not closure:
+                continue  # not a jit-carrying cache write
+            # a closure name is key-derived when every ORIGIN of its
+            # assignment chain (a name with no recorded local
+            # derivation) is the key itself, `self`, or module scope;
+            # an origin that is a function PARAMETER outside the key is
+            # exactly the hazard: the trace varies with it, the cache
+            # key does not
+            key_closure = _transitive(_names_in(target.slice), deps)
+            hazards = set()
+            for n in sorted(closure):
+                if n == "self" or n in module_names or n in key_closure:
+                    continue
+                chain = _transitive({n}, deps)
+                origins = {r for r in chain if r not in deps} or {n}
+                hazards |= {r for r in origins
+                            if r in params and r not in key_closure
+                            and r != "self" and r not in module_names}
+            hazards = sorted(hazards)
+            if hazards and not _suppressed(pragmas, node.lineno):
+                findings.append(Finding(
+                    path, node.lineno, "JIT01",
+                    f"jit cache write keyed on {sorted(_names_in(target.slice))} "
+                    f"but the cached trace also depends on {hazards} — a "
+                    "call differing only there reuses the wrong compiled "
+                    "program (add them to the key or derive them from it)"))
+
+
+# --- PROM: dtype promotion ----------------------------------------------------
+
+def _lint_promotion(tree, path, src, findings):
+    pragmas = _pragma_lines(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp):
+            for side in (node.left, node.right):
+                if isinstance(side, ast.Constant) \
+                        and isinstance(side.value, float):
+                    other = node.right if side is node.left else node.left
+                    if isinstance(other, ast.Constant):
+                        continue  # constant folding, no array involved
+                    if _suppressed(pragmas, node.lineno):
+                        continue
+                    findings.append(Finding(
+                        path, node.lineno, "PROM01",
+                        f"float literal {side.value!r} in kernel-module "
+                        "arithmetic: jnp silently promotes uint32 "
+                        "operands to f32 (use an int, or mark the "
+                        "host-only expression with # analysis: ok(...))"))
+                    break
+        elif isinstance(node, ast.Attribute) and node.attr == "float64":
+            if not _suppressed(pragmas, node.lineno):
+                findings.append(Finding(
+                    path, node.lineno, "PROM02",
+                    "float64 reference in a kernel module (the limb "
+                    "pipeline is 32-bit end to end)"))
+
+
+# --- LOCK01: lock discipline --------------------------------------------------
+
+def _lock_attrs(cls):
+    """Attrs assigned threading.Lock()/RLock() anywhere in the class."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if name in ("Lock", "RLock"):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _with_lock_ranges(method, locks):
+    """(start, end) line ranges of `with self.<lock>` bodies."""
+    ranges = []
+    for node in ast.walk(method):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in locks:
+                end = max(getattr(n, "end_lineno", n.lineno)
+                          for n in node.body)
+                ranges.append((node.body[0].lineno
+                               if node.body else node.lineno, end))
+                break
+    return ranges
+
+
+def _flat_targets(targets):
+    """Assignment targets with tuple/list unpacking flattened."""
+    out = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(_flat_targets(t.elts))
+        else:
+            out.append(t)
+    return out
+
+
+def _writes_in(method):
+    """[(attr, line)] of self-attribute mutations in a method."""
+    out = []
+    for node in ast.walk(method):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in _flat_targets(targets):
+                attr = _self_attr(t)
+                if attr:
+                    out.append((attr, node.lineno,
+                                isinstance(t, ast.Subscript)))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in _flat_targets([node.target]):
+                attr = _self_attr(t)
+                if attr:
+                    out.append((attr, node.lineno, False))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr:
+                    out.append((attr, node.lineno, True))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr:
+                out.append((attr, node.lineno, True))
+    return out
+
+
+def _reads_in(method):
+    """[(attr, line)] of self-attribute loads in a method."""
+    out = []
+    for node in ast.walk(method):
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.ctx, ast.Load):
+            attr = _self_attr(node)
+            if attr:
+                out.append((attr, node.lineno))
+    return out
+
+
+def _method_calls(method):
+    """Names of self.<m>(...) calls made by a method, with lines."""
+    out = []
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.append((node.func.attr, node.lineno))
+    return out
+
+
+def _lint_locks(tree, path, src, findings):
+    pragmas = _pragma_lines(src)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = _lock_attrs(cls)
+        if not locks:
+            continue
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, ast.FunctionDef)}
+        ranges = {name: _with_lock_ranges(m, locks)
+                  for name, m in methods.items()}
+
+        def _in_lock(name, line):
+            return any(a <= line <= b for a, b in ranges.get(name, ()))
+
+        # fixpoint: a method is lock-held if every intra-class call site
+        # is inside a lock scope or in a lock-held method (__init__ and
+        # the lock-holding frames count as held: single-threaded
+        # construction / already-serialized)
+        held = {"__init__"}
+        callers = {}  # method -> [(caller, line)]
+        for name, m in methods.items():
+            for callee, line in _method_calls(m):
+                callers.setdefault(callee, []).append((name, line))
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in held or name not in callers:
+                    continue
+                if all(caller in held or _in_lock(caller, line)
+                       for caller, line in callers[name]):
+                    held.add(name)
+                    changed = True
+
+        locked_writers = {}    # attr -> first locked write line
+        locked_readers = {}    # attr -> first locked read line
+        unlocked_writers = {}  # attr -> [(method, line)]
+        for name, m in methods.items():
+            if name == "__init__":
+                continue
+            for attr, line, _sub in _writes_in(m):
+                if attr in locks:
+                    continue
+                if name in held or _in_lock(name, line):
+                    locked_writers.setdefault(attr, line)
+                else:
+                    unlocked_writers.setdefault(attr, []).append(
+                        (name, line))
+            for attr, line in _reads_in(m):
+                if attr not in locks \
+                        and (name in held or _in_lock(name, line)):
+                    locked_readers.setdefault(attr, line)
+
+        for attr, sites in unlocked_writers.items():
+            if attr in locked_writers:
+                code, other = "LOCK01", ("written under `with self.<lock>`"
+                                         f" at line {locked_writers[attr]}")
+            elif attr in locked_readers:
+                code, other = "LOCK02", ("read under `with self.<lock>` at"
+                                         f" line {locked_readers[attr]}")
+            else:
+                continue
+            for method, line in sites:
+                if _suppressed(pragmas, line):
+                    continue
+                findings.append(Finding(
+                    path, line, code,
+                    f"{cls.name}.{attr} is {other} but mutated without "
+                    f"the lock in {method}()"))
+
+
+# --- driver -------------------------------------------------------------------
+
+def _module_globals(tree):
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+    return names
+
+
+def _iter_py(root, subdirs):
+    for sub in subdirs:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for fname in sorted(os.listdir(d)):
+            if fname.endswith(".py"):
+                yield os.path.join(d, fname)
+
+
+def run_lints(pkg_root=_PKG):
+    """All lints over their target directories. Returns [Finding]."""
+    findings = []
+    seen = set()
+    for path in _iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS):
+        if path in seen:
+            continue
+        seen.add(path)
+        with open(path) as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+        rel = os.path.relpath(path, pkg_root)
+        top = rel.split(os.sep)[0]
+        if top in KERNEL_DIRS:
+            _lint_jit_cache(tree, path, src, _module_globals(tree),
+                            findings)
+            _lint_promotion(tree, path, src, findings)
+        if top in LOCK_DIRS:
+            _lint_locks(tree, path, src, findings)
+    return findings
+
+
+def lint_source(src, path="<string>", kinds=("jit", "prom", "lock")):
+    """Lint one source string (unit tests / editor integration)."""
+    findings = []
+    tree = ast.parse(src, filename=path)
+    if "jit" in kinds:
+        _lint_jit_cache(tree, path, src, _module_globals(tree), findings)
+    if "prom" in kinds:
+        _lint_promotion(tree, path, src, findings)
+    if "lock" in kinds:
+        _lint_locks(tree, path, src, findings)
+    return findings

@@ -1,0 +1,242 @@
+"""Kernel registry: the production entry points the verifier must prove.
+
+One place enumerates every hot kernel with its REAL call shapes and the
+documented input/output bounds, so `python -m distributed_plonk_tpu.analysis
+--strict` is a single proof obligation covering:
+
+- field mul/add/sub (Fr and Fq, BOTH multiplier paths — the default
+  f32/MXU byte-product path and the u32 reference path),
+- `_carry_sweep` at full-u32 input (its own contract: limbs < 2^16 out),
+- the NTT stage pipeline for all 8 (inverse, coset, boundary) modes at
+  odd AND even log2(n) (radix-4 default plus the radix-2 parity core),
+- MSM digit extraction at the prover's real n+2/n+3 blinded handle
+  widths (signed c=7, signed c=8, unsigned c=4 small-window),
+- the bucket-update scan in every plane-update strategy the platform
+  split can pick (onehot+packed, onehot unpacked, put),
+- the MSM finish tail / plane folds, and the complete projective +
+  Jacobian curve adds.
+
+Shapes are representative, not production-sized: interval propagation is
+width-generic for every rule except reduction/contraction counts, and
+those are taken from the traced shape — the registry picks shapes whose
+reduction widths EQUAL or EXCEED production's per-column term counts
+(limb counts are fixed; scan lengths only repeat the same body). Entries
+that depend on a module-level mode latch (DPT_FIELD_MUL,
+DPT_BUCKET_UPDATE, DPT_PLANE_PACK) re-point the latch around the trace
+so both sides of every platform split are verified regardless of the
+machine running the check.
+"""
+
+from . import bounds as B
+from .bounds import Bound, limb_rows
+
+import jax.numpy as jnp
+import numpy as np
+
+U16 = (1 << 16) - 1
+U32 = (1 << 32) - 1
+
+
+class Entry:
+    def __init__(self, name, fn, args, out_bounds=None, patches=()):
+        self.name = name
+        self.fn = fn
+        self.args = args
+        self.out_bounds = out_bounds
+        self.patches = tuple(patches)  # ((module, attr, value), ...)
+
+    def check(self, strict=True):
+        saved = [(m, a, getattr(m, a)) for m, a, _ in self.patches]
+        for m, a, v in self.patches:
+            setattr(m, a, v)
+        try:
+            return B.check_fn(self.name, self.fn, self.args,
+                              out_bounds=self.out_bounds, strict=strict)
+        finally:
+            for m, a, v in saved:
+                setattr(m, a, v)
+
+
+def _field_entries():
+    from ..backend import field_jax as FJ
+
+    out = []
+    for spec in (FJ.FR, FJ.FQ):
+        L = spec.n_limbs
+        pair = (limb_rows(L, 8), limb_rows(L, 8))
+        one = (limb_rows(L, 8),)
+        limbs_out = [(0, U16)]
+        n = spec.name.lower()
+        for mul_path in (True, False):  # f32/MXU default, u32 reference
+            tag = "f32" if mul_path else "u32"
+            out.append(Entry(
+                f"field/{n}_mont_mul_{tag}",
+                lambda a, b, s=spec: FJ.mont_mul(s, a, b), pair,
+                limbs_out, patches=[(FJ, "_F32_MUL", mul_path)]))
+        out.append(Entry(f"field/{n}_add",
+                         lambda a, b, s=spec: FJ.add(s, a, b), pair,
+                         limbs_out))
+        out.append(Entry(f"field/{n}_sub",
+                         lambda a, b, s=spec: FJ.sub(s, a, b), pair,
+                         limbs_out))
+        out.append(Entry(f"field/{n}_neg",
+                         lambda a, s=spec: FJ.neg(s, a), one, limbs_out))
+        out.append(Entry(f"field/{n}_to_mont",
+                         lambda a, s=spec: FJ.to_mont(s, a), one,
+                         limbs_out))
+        out.append(Entry(f"field/{n}_from_mont",
+                         lambda a, s=spec: FJ.from_mont(s, a), one,
+                         limbs_out))
+    # the sweep itself, at its weakest precondition (ANY u32 columns):
+    # output limbs < 2^16 and a carry bounded by hi[-1] + 1
+    out.append(Entry("field/carry_sweep", FJ._carry_sweep,
+                     (Bound((FJ.FR.n_limbs, 8), jnp.uint32, 0, U32),),
+                     [(0, U16), (0, 1 << 16)]))
+    out.append(Entry("field/pack_unpack_limb_pairs",
+                     lambda v: FJ.unpack_limb_pairs(FJ.pack_limb_pairs(v)),
+                     (limb_rows(8, 16),), [(0, U16)]))
+    out.append(Entry("field/cumsum_mont",
+                     lambda v: FJ.cumsum_mont(FJ.FR, v),
+                     (limb_rows(16, 8),), [(0, U16)]))
+    return out
+
+
+def _ntt_entries():
+    from ..backend import ntt_jax as NTT
+
+    out = []
+    # odd + even log2(n): n=32 exercises the radix-2 fixup stage, n=64
+    # the peeled-last-radix-4 path; every (inverse, coset, boundary)
+    # combination is a distinct fused program
+    for n in (32, 64):
+        plan = NTT.get_plan(n)
+        for inverse in (False, True):
+            for coset in (False, True):
+                for boundary in ("mont", "plain"):
+                    fn, consts = plan.traced_kernel(
+                        inverse, coset, boundary=boundary, radix=4)
+                    cnp = {k: np.asarray(v) for k, v in consts.items()}
+                    out.append(Entry(
+                        f"ntt/n{n}_radix4_inv{int(inverse)}"
+                        f"_coset{int(coset)}_{boundary}",
+                        fn, (limb_rows(16, n), cnp), [(0, U16)]))
+        # radix-2 parity core (one mode per n keeps the sweep cheap; the
+        # stage body is mode-independent modulo pre/post table muls,
+        # which the inverse+coset variant includes)
+        fn, consts = plan.traced_kernel(True, True, boundary="mont",
+                                        radix=2)
+        cnp = {k: np.asarray(v) for k, v in consts.items()}
+        out.append(Entry(f"ntt/n{n}_radix2_inv1_coset1_mont", fn,
+                         (limb_rows(16, n), cnp), [(0, U16)]))
+        # batched kernel (the prover's round-1/round-3 launches)
+        fn, consts = plan.traced_kernel(False, True, radix=4, batch=True)
+        cnp = {k: np.asarray(v) for k, v in consts.items()}
+        out.append(Entry(f"ntt/n{n}_radix4_batch3_coset", fn,
+                         (limb_rows(16, 3, n), cnp), [(0, U16)]))
+    return out
+
+
+def _msm_entries():
+    from ..backend import msm_jax as MSM
+
+    out = []
+    # digit extraction at the REAL blinded handle widths the prover
+    # commits (domain n -> handles of width n+2 / n+3; jit caches per
+    # exact width — the PR 3 bug class this registry pins)
+    dom = 64
+    for Lw in (dom + 2, dom + 3):
+        out.append(Entry(
+            f"msm/digits_signed_c7_L{Lw}",
+            lambda h: MSM.signed_digits7_from_mont(h, padded_n=2 * dom),
+            (limb_rows(16, Lw),), [(0, 127)]))
+        out.append(Entry(
+            f"msm/digits_signed_c8_L{Lw}",
+            lambda h: MSM.signed_digits_from_mont(h, padded_n=2 * dom),
+            (limb_rows(16, Lw),), [(0, 255)]))
+        out.append(Entry(
+            f"msm/digits_unsigned_c4_L{Lw}",
+            lambda h: MSM.digits_from_mont(h, 4, padded_n=2 * dom),
+            (limb_rows(16, Lw),), [(0, 15)]))
+
+    # bucket-update scan: signed c=7 shape (the default batched
+    # pipeline), under every plane-update strategy
+    nc, Bt, W = 16, 2, 37
+    scan_args = (limb_rows(24, nc), limb_rows(24, nc),
+                 Bound((nc,), jnp.bool_, 0, 1),
+                 Bound((Bt, W, nc), jnp.uint32, 0, 127))
+    plane_out = [(0, U16)] * 3
+    for mode, pack in (("onehot", True), ("onehot", False), ("put", False)):
+        tag = f"{mode}{'_packed' if pack else ''}"
+        out.append(Entry(
+            f"msm/bucket_scan_signed_{tag}",
+            lambda ax, ay, ainf, d: MSM.bucket_planes_batch_signed(
+                ax, ay, ainf, d, group=1),
+            scan_args, plane_out,
+            patches=[(MSM, "_BUCKET_UPDATE", mode),
+                     (MSM, "_PLANE_PACK", pack)]))
+    # unsigned small-window scan (tiny keys, c=4: 64 windows x 16
+    # buckets, digits < 16)
+    uargs = (limb_rows(24, nc), limb_rows(24, nc),
+             Bound((nc,), jnp.bool_, 0, 1),
+             Bound((Bt, 64, nc), jnp.uint32, 0, 15))
+    for mode, pack in (("onehot", True), ("put", False)):
+        tag = f"{mode}{'_packed' if pack else ''}"
+        out.append(Entry(
+            f"msm/bucket_scan_unsigned_{tag}",
+            lambda ax, ay, ainf, d: MSM.bucket_planes_batch(
+                ax, ay, ainf, d, group=1),
+            uargs, plane_out,
+            patches=[(MSM, "_BUCKET_UPDATE", mode),
+                     (MSM, "_PLANE_PACK", pack)]))
+
+    # finish tail (both bucket semantics) + cross-chunk fold
+    out.append(Entry(
+        "msm/finish_signed_c7",
+        lambda bx, by, bz: MSM.finish(bx, by, bz, signed=True),
+        tuple(limb_rows(24, 37, 64) for _ in range(3)), plane_out))
+    out.append(Entry(
+        "msm/finish_unsigned_c4",
+        lambda bx, by, bz: MSM.finish(bx, by, bz, signed=False),
+        tuple(limb_rows(24, 64, 16) for _ in range(3)), plane_out))
+    out.append(Entry(
+        "msm/fold_planes", MSM.fold_planes,
+        tuple(limb_rows(4, 24, 8, 16) for _ in range(3)), plane_out))
+    return out
+
+
+def _curve_entries():
+    from ..backend import curve_jax as CJ
+
+    pt = lambda: tuple(limb_rows(24, 8) for _ in range(3))
+    coords_out = [(0, U16)] * 3
+    return [
+        Entry("curve/proj_add", CJ.proj_add, (pt(), pt()), coords_out),
+        Entry("curve/proj_add_mixed", CJ.proj_add_mixed,
+              (pt(), (limb_rows(24, 8), limb_rows(24, 8)),
+               Bound((8,), jnp.bool_, 0, 1)), coords_out),
+        Entry("curve/jac_add", CJ.jac_add, (pt(), pt()), coords_out),
+        Entry("curve/jac_double", CJ.jac_double, (pt(),), coords_out),
+    ]
+
+
+def build_registry():
+    """All production entries (list of Entry)."""
+    return (_field_entries() + _ntt_entries() + _msm_entries()
+            + _curve_entries())
+
+
+def run_bounds(strict=True, names=None, progress=None, contracts=True):
+    """Check every registry entry (+ the carry contracts unless the
+    caller runs them separately). Returns (violations, entries_checked)."""
+    violations = list(B.check_contracts()) if contracts else []
+    entries = build_registry()
+    checked = 0
+    for e in entries:
+        if names is not None and not any(s in e.name for s in names):
+            continue
+        v = e.check(strict=strict)
+        checked += 1
+        if progress is not None:
+            progress(e.name, v)
+        violations.extend(v)
+    return violations, checked

@@ -138,6 +138,57 @@ FR = FieldSpec("Fr", R_MOD, FR_LIMBS, FR_MONT_R2, FR_MONT_INV)
 FQ = FieldSpec("Fq", Q_MOD, FQ_LIMBS, FQ_MONT_R2, FQ_MONT_INV)
 
 
+# --- checked carry/exactness contracts ---------------------------------------
+# Every _carry_sweep caller that DROPS the carry lane relies on one of the
+# side conditions below: they are modular-number-theory facts about the
+# field constants that per-element interval analysis (analysis/bounds.py)
+# cannot derive, because the limb-column representation is redundant (a
+# column vector bounds the value only up to ~2^7 x slack). They used to
+# live as prose in _carry_sweep's docstring; now they are machine-checked
+# inequalities over the ACTUAL moduli/limb counts — `python -m
+# distributed_plonk_tpu.analysis` (and tests/test_analysis.py) evaluates
+# every contract for both FieldSpecs, so a field/limb-layout change that
+# silently breaks a zero-carry assumption fails CI instead of corrupting
+# proofs. `R(spec)` below is the Montgomery radix 2^(16*L).
+
+def _R(spec):
+    return 1 << (LIMB_BITS * spec.n_limbs)
+
+
+CARRY_CONTRACTS = (
+    {"name": "cond_sub_fits",
+     "claim": "v < 2p fits in L limbs (2p <= R), so _cond_sub_mod/add's "
+              "lane-1 sweep and sub's lane-2 wrap both have carry <= 1 "
+              "and the assumed-zero carry of the reduced lane is zero",
+     "holds": lambda spec: 2 * spec.mod <= _R(spec)},
+    {"name": "mont_hi_fits",
+     "claim": "for reduced inputs a,b < p the Montgomery high half "
+              "(a*b + m*p)/R is < 2p (p^2 + R*p <= 2*p*R, i.e. p <= R), "
+              "so mont_mul's final _cond_sub_mod sees a value that fits",
+     "holds": lambda spec: spec.mod ** 2 + _R(spec) * spec.mod
+              <= 2 * spec.mod * _R(spec)},
+    {"name": "u32_colsum",
+     "claim": "u32-path product columns stay carry-free: <= 2L split "
+              "halves per column, each < 2^16, lo+hi recombined "
+              "(4L * (2^16-1) < 2^32)",
+     "holds": lambda spec: 4 * spec.n_limbs * (LIMB_MASK + 1) < 1 << 32},
+    {"name": "byte_colsum_f32_exact",
+     "claim": "f32-path byte-column sums stay exactly representable: "
+              "<= 4L byte products per column, each <= 255^2 "
+              "(4L * 255^2 <= 2^24, the f32 integer round-trip bound)",
+     "holds": lambda spec: 4 * spec.n_limbs * 255 ** 2 <= 1 << 24},
+    {"name": "combined_cols_u32",
+     "claim": "recombined 16-bit columns (even + 2^8 * odd byte columns) "
+              "fit u32 before the sweep (4L * 255^2 * 257 < 2^32)",
+     "holds": lambda spec: 4 * spec.n_limbs * 255 ** 2 * 257 < 1 << 32},
+    {"name": "sweep_preadd_single_bit",
+     "claim": "_carry_sweep's pre-add s_i = lo_i + hi_{i-1} < 2^17, so "
+              "the residual inter-limb carry is a single bit and the "
+              "Kogge-Stone (generate, propagate) recurrence is exact",
+     "holds": lambda spec: 2 * LIMB_MASK < 1 << 17},
+)
+
+
 def _bcast_const(limbs, ndim):
     """(L,) host constant -> (L, 1, ..., 1) for broadcasting against batch."""
     return jnp.asarray(limbs).reshape(limbs.shape + (1,) * (ndim - 1))
@@ -148,8 +199,12 @@ def _carry_sweep(cols):
     the f32 path feeds combined even+odd byte columns up to ~2^30 here).
 
     Returns (limbs, carry_out): limbs (K, *batch) all < 2^16, carry_out the
-    overflow past the top limb (zero whenever the caller's bound guarantees
-    the value fits in K limbs).
+    overflow past the top limb. CONTRACT: callers that drop the carry
+    assert the value fits in K limbs (or intend the mod-2^(16K)
+    truncation); each such assumption is a named, machine-checked
+    inequality in CARRY_CONTRACTS, evaluated for every FieldSpec by the
+    static verifier (analysis/bounds.py::check_contracts) — do not add a
+    carry-dropping call site without extending that table.
 
     Log-depth Kogge-Stone instead of a K-step ripple chain: pre-add each
     column's high bits into the next column (s_i = lo_i + hi_{i-1} < 2^17,

@@ -483,14 +483,20 @@ def _signed_recode(u, bias, xp):
     """Windowed unsigned digits -> packed signed digits (d + bias, d in
     [-bias, bias-1]): the ONE carry loop shared by the host (xp=numpy)
     and device (xp=jax.numpy) recodes at both window widths (bias 128
-    for c=8, 64 for c=7)."""
-    shift = bias.bit_length()  # 2*bias == 1 << shift
+    for c=8, 64 for c=7).
+
+    The wrap is a MASK, not `t + bias - (carry << shift)`: with t <
+    2*bias + 1 the two are identical ((t + bias) mod 2*bias), but the
+    subtraction's uint32 interval dips below zero unless the verifier
+    knows carry == (t >= bias) — a correlation interval analysis cannot
+    see (analysis/bounds.py flagged it); the masked form is provably
+    in-range for any t the digit bound admits."""
     outs = []
     carry = xp.zeros_like(u[0])
     for w in range(u.shape[0]):
         t = u[w] + carry
         carry = (t >= bias).astype(xp.uint32)
-        outs.append(t + bias - (carry << shift))
+        outs.append((t + bias) & (2 * bias - 1))
     return outs, carry
 
 

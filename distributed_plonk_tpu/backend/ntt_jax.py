@@ -414,6 +414,25 @@ class NttPlan:
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
+    def traced_kernel(self, inverse=False, coset=False, boundary="mont",
+                      radix=None, batch=False):
+        """(jitted fn, consts dict) for one kernel variant — the raw
+        pair behind `kernel`/`kernel_batch`'s memo. The static verifier
+        (analysis/registry.py) traces `fn(v, consts)` through
+        jax.make_jaxpr to interval-check the whole stage pipeline; AOT
+        tooling can reuse it for explicit lower()/compile() too."""
+        radix = self._effective_radix(radix)
+        if batch:
+            if boundary != "mont":
+                raise ValueError(
+                    "batch kernels are Montgomery-boundary only")
+            self.kernel_batch(inverse, coset, radix=radix)
+            key = (inverse, coset, "batch", radix)
+        else:
+            self.kernel(inverse, coset, boundary=boundary, radix=radix)
+            key = (inverse, coset, boundary, radix)
+        return self._fns[key]
+
     def aot_compile(self, batch_sizes=(), boundaries=("mont", "plain"),
                     radix=None):
         """Ahead-of-time lower + compile every (inverse, coset) kernel

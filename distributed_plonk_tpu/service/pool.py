@@ -131,8 +131,12 @@ class WorkerPool:
             self._workers[dead.index] = replacement
 
     def shutdown(self):
-        self._stopping = True
+        # _stopping is the respawn gate _respawn checks under the lock:
+        # setting it inside the same lock closes the window where a
+        # concurrently dying worker respawns after shutdown decided to
+        # stop (LOCK02 finding of the lock-discipline lint)
         with self._lock:
+            self._stopping = True
             workers = list(self._workers)
         for _ in workers:
             self._dispatch_q.put(_STOP)

@@ -29,9 +29,15 @@ Contracts:
 - Cross-process: readers reload the manifest from disk on a miss, so a
   store populated by another process (warmup job, previous server run) is
   visible without restart, and a plain hit never writes the manifest, so
-  readers cannot clobber a writer. Concurrent WRITERS are not coordinated
-  beyond atomic replacement — last manifest write wins; run one
-  warmup/serve writer per store (the intended deployment).
+  readers cannot clobber a writer. Concurrent WRITERS are safe too:
+  every manifest read-modify-write (put/delete) runs under an fcntl
+  lockfile (`manifest.lock`) and starts by MERGING the on-disk manifest
+  into memory — disk is the source of truth for the entry set (a key we
+  hold that disk lacks was deleted by another writer), while in-memory
+  LRU recency survives as max(seq). Two warmup/serve writers on one
+  store can no longer drop each other's entries (the PR 2 ROADMAP gap);
+  on platforms without fcntl the lock degrades to the old
+  atomic-replace-only behavior.
 
 Metrics (duck-typed `inc`/`gauge`, e.g. service.metrics.Metrics or its
 `scoped("store")` view): hits, misses, corrupt, evictions, put_bytes,
@@ -45,9 +51,38 @@ import os
 import threading
 import time
 
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-posix fallback
+    fcntl = None
+
 log = logging.getLogger("dpt.store")
 
 MANIFEST_VERSION = 1
+
+
+class _FileLock:
+    """Advisory exclusive lock on a sidecar file (blocking). Serializes
+    manifest read-modify-write across PROCESSES; the in-process
+    threading lock still serializes threads within one store object.
+    No-ops when fcntl is unavailable."""
+
+    def __init__(self, path):
+        self.path = path
+        self._f = None
+
+    def __enter__(self):
+        if fcntl is not None:
+            self._f = open(self.path, "a+")
+            fcntl.flock(self._f.fileno(), fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        if self._f is not None:
+            fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+            self._f.close()
+            self._f = None
+        return False
 
 
 class _NullMetrics:
@@ -66,8 +101,13 @@ class ArtifactStore:
         self._lock = threading.Lock()
         os.makedirs(os.path.join(root, "objects"), exist_ok=True)
         self._manifest_path = os.path.join(root, "manifest.json")
-        self._manifest = self._load_manifest()
-        self._sweep_orphans()
+        self._file_lock = _FileLock(os.path.join(root, "manifest.lock"))
+        # load + orphan sweep under the file lock: a lock-free sweep
+        # could delete an old blob a concurrent put() just revived via
+        # its exists()-skip path (entry published, backing blob gone)
+        with self._file_lock:
+            self._manifest = self._load_manifest()
+            self._sweep_orphans()
         self._publish_gauges()
 
     # -- manifest -------------------------------------------------------------
@@ -111,10 +151,31 @@ class ArtifactStore:
                     pass
 
     def _save_manifest(self):
-        tmp = self._manifest_path + ".tmp"
+        tmp = self._manifest_path + ".tmp.%d" % os.getpid()
         with open(tmp, "w") as f:
             json.dump(self._manifest, f)
         os.replace(tmp, self._manifest_path)
+
+    def _merge_from_disk(self):
+        """Merge the on-disk manifest into memory (writers call this
+        with the file lock held; get()'s miss path calls it lock-free,
+        which is safe because _save_manifest publishes atomically).
+
+        Disk is authoritative for the ENTRY SET: every write by any
+        process saves before releasing the file lock, so an entry we
+        hold that disk lacks was deleted by another writer (eviction),
+        and a disk entry we lack was added by one. What memory
+        contributes is recency — LRU touches are in-memory-only until
+        the next write — so per-key seq merges as max(), and the global
+        counter as max() too, keeping seq monotonic across writers."""
+        disk = self._load_manifest()
+        mem = self._manifest["entries"]
+        for key, e in disk["entries"].items():
+            m = mem.get(key)
+            if m is not None and m["digest"] == e["digest"]:
+                e["seq"] = max(e["seq"], m["seq"])
+        disk["seq"] = max(disk["seq"], self._manifest["seq"])
+        self._manifest = disk
 
     def _publish_gauges(self):
         ents = self._manifest["entries"]
@@ -152,24 +213,38 @@ class ArtifactStore:
         Returns the content digest."""
         digest = hashlib.sha256(blob).hexdigest()
         path = self._obj_path(digest)
+        def _write_blob():
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+
         with self._lock:
+            # bulk blob I/O OUTSIDE the cross-process flock (multi-MB
+            # key blobs must not serialize concurrent warmup writers);
+            # content-addressed atomic rename makes it idempotent. The
+            # existence is RE-CHECKED under the flock: a concurrent
+            # writer's eviction between our write and our manifest
+            # insert would otherwise publish an entry with no backing
+            # blob
             if not os.path.exists(path):
-                os.makedirs(os.path.dirname(path), exist_ok=True)
-                tmp = path + ".tmp.%d" % os.getpid()
-                with open(tmp, "wb") as f:
-                    f.write(blob)
-                os.replace(tmp, path)
-            old = self._manifest["entries"].get(key)
-            self._manifest["entries"][key] = {
-                "digest": digest, "bytes": len(blob),
-                "seq": self._next_seq(), "created": time.time(),
-                "meta": dict(meta or {}),
-            }
-            if old is not None and old["digest"] != digest:
-                self._drop_blob_if_unreferenced(old["digest"])
-            self.metrics.inc("put_bytes", len(blob))
-            self._evict_over_budget(protect=key)
-            self._save_manifest()
+                _write_blob()
+            with self._file_lock:
+                if not os.path.exists(path):  # evicted in the window
+                    _write_blob()
+                self._merge_from_disk()
+                old = self._manifest["entries"].get(key)
+                self._manifest["entries"][key] = {
+                    "digest": digest, "bytes": len(blob),
+                    "seq": self._next_seq(), "created": time.time(),
+                    "meta": dict(meta or {}),
+                }
+                if old is not None and old["digest"] != digest:
+                    self._drop_blob_if_unreferenced(old["digest"])
+                self.metrics.inc("put_bytes", len(blob))
+                self._evict_over_budget(protect=key)
+                self._save_manifest()
             self._publish_gauges()
         return digest
 
@@ -181,19 +256,38 @@ class ArtifactStore:
             e = self._manifest["entries"].get(key)
             if e is None:
                 # another process may have populated the store since we
-                # loaded the manifest (warmup job, previous server run)
-                self._manifest = self._load_manifest()
+                # loaded the manifest (warmup job, previous server run);
+                # merge rather than overwrite so in-memory LRU touches
+                # (persisted only on the next write) keep their recency
+                self._merge_from_disk()
                 e = self._manifest["entries"].get(key)
             if e is None:
                 self.metrics.inc("misses")
                 return None
             blob = self._read_verified(key, e)
             if blob is None:
-                self.metrics.inc("corrupt")
-                self._delete_locked(key)
-                self._save_manifest()
-                self._publish_gauges()
-                return None
+                # before declaring corruption, resync: another writer
+                # may have re-put the key (old blob legitimately gone)
+                # or deleted it — neither is an integrity failure
+                with self._file_lock:
+                    self._merge_from_disk()
+                    cur = self._manifest["entries"].get(key)
+                    if cur is None:
+                        self.metrics.inc("misses")
+                        return None
+                    # re-read unconditionally: even a SAME-digest entry
+                    # may have been evicted and re-put by another writer
+                    # (deterministic key blobs), making the blob valid
+                    # again on disk
+                    blob = self._read_verified(key, cur)
+                    e = cur
+                    if blob is None:
+                        self.metrics.inc("corrupt")
+                        self._delete_locked(key)
+                        self._save_manifest()
+                if blob is None:
+                    self._publish_gauges()
+                    return None
             self.metrics.inc("hits")
             # LRU touch, in memory only: a hit must NOT rewrite the
             # manifest — a reader that writes would clobber entries a
@@ -205,12 +299,14 @@ class ArtifactStore:
 
     def delete(self, key):
         with self._lock:
-            if key in self._manifest["entries"]:
-                self._delete_locked(key)
-                self._save_manifest()
-                self._publish_gauges()
-                return True
-            return False
+            with self._file_lock:
+                self._merge_from_disk()
+                found = key in self._manifest["entries"]
+                if found:
+                    self._delete_locked(key)
+                    self._save_manifest()
+            self._publish_gauges()
+            return found
 
     # -- internals (lock held) ------------------------------------------------
 

@@ -5,12 +5,25 @@
 # the identical surface.
 #
 # Usage:
-#   scripts/ci.sh        full tier-1 (the ROADMAP command, wall-clock budgeted)
-#   scripts/ci.sh fast   kernel-parity subset: NTT + MSM oracle/radix tests
-#                        only — the quick pre-commit check for kernel work
-#                        (~6 min of XLA-CPU compiles, no prover/mesh/service)
+#   scripts/ci.sh          full tier-1 (the ROADMAP command, wall-clock budgeted)
+#   scripts/ci.sh fast     kernel-parity subset: AST hazard lints (sub-second)
+#                          then NTT + MSM oracle/radix tests — the quick
+#                          pre-commit check for kernel work (~6 min of
+#                          XLA-CPU compiles, no prover/mesh/service)
+#   scripts/ci.sh analyze  static verifier, strict: jaxpr interval bounds over
+#                          the FULL kernel registry + carry contracts + repo
+#                          lints (python -m distributed_plonk_tpu.analysis,
+#                          ~90 s of pure tracing, nothing executes)
 cd "$(dirname "$0")/.."
+if [ "$1" = "analyze" ]; then
+  exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
+fi
 if [ "$1" = "fast" ]; then
+  # the AST lints cost <1 s and catch the jit-cache/promotion/lock bug
+  # classes before any compile starts; bounds stay in `analyze` (tracing
+  # the full registry is ~90 s)
+  env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis \
+    --only lint --strict -q || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ntt_jax.py tests/test_curve_msm_jax.py \
     tests/test_msm_update_paths.py tests/test_poly.py \
