@@ -208,11 +208,99 @@ def device_ntt_seconds():
     return single, batch, b, meta
 
 
+def _msm_stage_breakdown(ctx, reps=3):
+    """Per-stage wall-clock of the MSM pipeline at the context's real
+    chunk shape (mirrors _ntt_stage_breakdown): on-device digit
+    extraction / bucket-accumulation chunk (scan + group fold) /
+    cross-chunk plane merge / finish tail — so an MFU regression can be
+    pinned on a stage instead of just the end-to-end number."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_plonk_tpu.backend import msm_jax as MJ
+
+    B = 1
+    W = -(-MJ.SCALAR_BITS // ctx.c_batch)
+    nc = min(ctx._chunk_lanes(B, W), ctx.padded_n)
+    g = MJ._group_size_batch(nc, B, ctx.c_batch, signed=ctx.signed)
+    ax, ay, ainf = ctx.point
+    rng = np.random.default_rng(6)
+    h = jnp.asarray(rng.integers(0, 1 << 16, size=(16, ctx.padded_n),
+                                 dtype=np.uint32))
+
+    def timed(fn, *args, sync):
+        out = fn(*args)
+        sync(out)  # compile + warm, then fence the loop
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        sync(out)
+        return round((time.perf_counter() - t0) / reps, 6)
+
+    sync_rows = lambda o: np.asarray(o[:1, :1])
+    sync_planes = lambda o: np.asarray(o[0][:1, :1, :1])
+    out = {"chunk": int(nc), "group": int(g),
+           "kernel": MJ._kernel_mode()}
+    out["digits_s"] = timed(ctx._digits_batch_fn, h, sync=sync_rows)
+    digits = ctx._digits_batch_fn(h)[None]  # (1, W, padded_n)
+    fn = ctx._chunk_fn(nc, g)
+    chunk_args = (ax[:, :nc], ay[:, :nc], ainf[:nc], digits[:, :, :nc])
+    out["bucket_scan_s"] = timed(fn, *chunk_args, sync=sync_planes)
+    planes = fn(*chunk_args)
+    out["fold_merge_s"] = timed(ctx._merge_fn, planes, planes,
+                                sync=sync_planes)
+    out["finish_s"] = timed(ctx._finish_fn(B), *planes,
+                            sync=lambda o: np.asarray(o[0][:1, :1]))
+    return out
+
+
+def _msm_kernel_ab(bases, scalars, ctx):
+    """In-run A/B of the fused Pallas bucket kernel (DPT_MSM_KERNEL=
+    pallas, VMEM-resident planes) vs the XLA onehot scan, same chip and
+    arrays — makes `msm_pallas_speedup_vs_onehot` attributable without
+    a second bench run. On TPU both modes run the full-size MSM on the
+    SAME context (chunk executables are keyed by kernel mode); CPU-only
+    runs time the interpret-mode kernel at a reduced size and record
+    the basis as degraded rather than blocking."""
+    import jax
+    from distributed_plonk_tpu.backend import msm_jax as MJ
+
+    if jax.default_backend() == "tpu":
+        ctx_ab, ab_scalars = ctx, scalars
+        basis = "tpu-full-size"
+    else:
+        nn = min(len(bases), 1 << 9)
+        ctx_ab = MJ.MsmContext(bases[:nn])
+        ab_scalars = scalars[:nn]
+        basis = ("degraded: no TPU — interpret-mode kernel at "
+                 f"n={nn}, not a chip measurement")
+    times = {}
+    prev = MJ._MSM_KERNEL
+    try:
+        for mode in ("xla", "pallas"):
+            MJ._MSM_KERNEL = mode
+            ctx_ab.msm(ab_scalars)  # compile + warm
+            t0 = time.perf_counter()
+            ctx_ab.msm(ab_scalars)
+            times[mode] = time.perf_counter() - t0
+    finally:
+        MJ._MSM_KERNEL = prev
+    return {
+        "msm_ab_basis": basis,
+        "msm_ab_xla_onehot_s": round(times["xla"], 4),
+        "msm_ab_pallas_s": round(times["pallas"], 4),
+        "msm_pallas_speedup_vs_onehot":
+            round(times["xla"] / times["pallas"], 2),
+    }
+
+
 def device_msm_seconds():
     """2^LOG_N-point MSM (the reference's MSM micro-test scale,
-    src/dispatcher.rs:188-196: 2^11 distinct bases tiled up to 2^20)."""
+    src/dispatcher.rs:188-196: 2^11 distinct bases tiled up to 2^20).
+    Returns (seconds, meta) with the per-stage breakdown + the
+    pallas-vs-onehot A/B."""
     from distributed_plonk_tpu import curve as C
     from distributed_plonk_tpu.constants import R_MOD
+    from distributed_plonk_tpu.backend import msm_jax as MJ
     from distributed_plonk_tpu.backend.msm_jax import MsmContext
 
     rng = random.Random(3)
@@ -224,7 +312,22 @@ def device_msm_seconds():
     ctx.msm(scalars)  # compile + warm
     t0 = time.perf_counter()
     ctx.msm(scalars)
-    return time.perf_counter() - t0
+    msm_s = time.perf_counter() - t0
+
+    meta = {"msm_kernel": MJ._kernel_mode(), "msm_c": ctx.c_batch}
+    # diagnostics scale their rep count to the measured time, like the
+    # NTT breakdown, so a slow platform doesn't burn the inner budget
+    diag_reps = 3 if msm_s < 2.0 else 1
+    try:
+        meta["msm_stage_breakdown"] = _msm_stage_breakdown(
+            ctx, reps=diag_reps)
+    except Exception as e:  # diagnostic only; never fail the bench line
+        meta["msm_stage_breakdown_error"] = repr(e)
+    try:
+        meta.update(_msm_kernel_ab(bases, scalars, ctx))
+    except Exception as e:
+        meta["msm_ab_error"] = repr(e)
+    return msm_s, meta
 
 
 def device_mfu():
@@ -349,7 +452,8 @@ def inner_main():
     extra[f"ntt_2p{LOG_N}_vs_host_oracle"] = round(host_ntt_seconds() / ntt_dev, 2)
     _partial_put(extra)
 
-    msm_dev = device_msm_seconds()
+    msm_dev, msm_meta = device_msm_seconds()
+    extra.update(msm_meta)
     extra[f"msm_2p{LOG_N}_points_per_s"] = round(N / msm_dev)
     extra[f"msm_2p{LOG_N}_device_s"] = round(msm_dev, 3)
     _partial_put(extra)
@@ -541,7 +645,11 @@ def _degraded(reason, extra=None):
         out["cpu_ntt_2p14_device_s"] = cpu.get("ntt_2p14_device_s")
         out["cpu_ntt_2p14_elements_per_s"] = cpu.get("ntt_2p14_elements_per_s")
         for k in ("ntt_radix", "ntt_kernel_variant",
-                  "ntt_radix4_speedup_vs_radix2", "ntt_stage_breakdown"):
+                  "ntt_radix4_speedup_vs_radix2", "ntt_stage_breakdown",
+                  "msm_kernel", "msm_stage_breakdown", "msm_ab_basis",
+                  "msm_ab_xla_onehot_s", "msm_ab_pallas_s",
+                  "msm_pallas_speedup_vs_onehot", "msm_ab_error",
+                  "msm_stage_breakdown_error"):
             if k in cpu and k not in out:
                 out[k] = cpu[k]
     if extra:

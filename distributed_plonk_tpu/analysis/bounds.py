@@ -25,6 +25,15 @@ carry fixpoint (join-until-stable, bounded iterations) — a carry whose
 bound keeps growing is itself reported (`scan carry bounds do not
 stabilize`). `pjit` / custom-call wrappers are entered transparently.
 
+Pallas kernels: a `pallas_call` eqn is entered too — the kernel IS a
+jaxpr. Every input/output/scratch ref becomes one interval cell
+(_RefCell: full-coverage writes replace, partial writes join,
+read-before-any-write is the full dtype range), `pl.when` branches run
+from a shared entry state and join their exits, `program_id` is bounded
+by the enclosing grid, and the grid itself is a join-until-stable
+fixpoint (VMEM scratch persists across grid steps exactly like a scan
+carry). Outputs take their cells' stabilized bounds.
+
 Precision notes (sound, documented weakenings):
 - Intervals collapse array extent: one `[lo, hi]` per value, with exact
   intervals for concrete constants (twiddle/exponent tables).
@@ -156,6 +165,43 @@ def limb_rows(*shape):
     return Bound(shape, jnp.uint32, 0, (1 << 16) - 1)
 
 
+class _RefCell:
+    """Abstract state of one Pallas ref (input block / output block /
+    VMEM scratch): a single interval covering every element the ref has
+    ever held, or BOTTOM (None) before the first write. A full-coverage
+    write replaces the interval (strong update); a partial write joins
+    (the untouched region keeps its old bound); a partial write to
+    BOTTOM widens to the full dtype range — sound for kernels that may
+    read what they never wrote."""
+
+    __slots__ = ("dtype", "shape", "val")
+
+    def __init__(self, dtype, shape, val=None):
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(shape)
+        self.val = val  # AbsVal or None (= bottom / uninitialized)
+
+    def read(self, dtype, shape):
+        if self.val is None:
+            lo, hi = _dtype_range(dtype)
+            return AbsVal(dtype, shape, lo, hi,
+                          exact=np.dtype(dtype).kind != "f")
+        return AbsVal(dtype, shape, self.val.lo, self.val.hi,
+                      exact=self.val.exact)
+
+    def write(self, val, full):
+        norm = AbsVal(self.dtype, self.shape, val.lo, val.hi,
+                      exact=val.exact)
+        if full:
+            self.val = norm
+        elif self.val is None:
+            lo, hi = _dtype_range(self.dtype)
+            self.val = AbsVal(self.dtype, self.shape, lo, hi,
+                              exact=self.dtype.kind != "f")
+        else:
+            self.val = _join(self.val, norm)
+
+
 class Violation:
     def __init__(self, kernel, prim, message, where=""):
         self.kernel = kernel
@@ -216,6 +262,7 @@ class Interpreter:
         self.violations = []
         self.warnings = []
         self._check = True  # False while searching for a loop fixpoint
+        self._grids = []    # enclosing pallas_call grids (program_id bound)
 
     # -- reporting ------------------------------------------------------------
 
@@ -306,7 +353,8 @@ class Interpreter:
             if not isinstance(outs, (list, tuple)):
                 outs = [outs]
             for var, val in zip(eqn.outvars, outs):
-                self._check_dtype(eqn, val)
+                if isinstance(val, AbsVal):
+                    self._check_dtype(eqn, val)
                 env[var] = val
 
     def _subjaxpr(self, eqn):
@@ -723,11 +771,163 @@ class Interpreter:
     def _cond(self, eqn, ins):
         branches = eqn.params["branches"]
         pred, ops = ins[0], ins[1:]
+        # Pallas kernels pass VMEM refs into cond branches (pl.when):
+        # run every branch from the same entry cell state and join the
+        # exit states. A branch that never writes a cell contributes
+        # BOTTOM, which joins as identity — i.e. the analysis assumes a
+        # cell read after the cond was initialized by SOME branch or an
+        # earlier grid pass (the when(step==0) init idiom); a kernel
+        # that truly reads never-written scratch is its own bug.
+        cells = [o for o in ops if isinstance(o, _RefCell)]
+        snap = [c.val for c in cells]
+        exits = [None] * len(cells)
         outs = None
         for br in branches:
+            for c, v in zip(cells, snap):
+                c.val = v
             res = self.run(br, list(ops))
+            for i, c in enumerate(cells):
+                if exits[i] is None:
+                    exits[i] = c.val
+                elif c.val is not None:
+                    exits[i] = _join(exits[i], c.val)
             outs = res if outs is None else [
                 _join(a, b) for a, b in zip(outs, res)]
+        for c, v in zip(cells, exits):
+            c.val = v
+        return outs
+
+    # -- pallas kernels --------------------------------------------------------
+
+    def _p_program_id(self, eqn, ins):
+        axis = eqn.params.get("axis", 0)
+        hi = (1 << 31) - 1
+        if self._grids and axis < len(self._grids[-1]):
+            g = self._grids[-1][axis]
+            if isinstance(g, int):
+                hi = max(g - 1, 0)
+        return self._mk(eqn, 0, hi)
+
+    def _p_num_programs(self, eqn, ins):
+        axis = eqn.params.get("axis", 0)
+        if self._grids and axis < len(self._grids[-1]) \
+                and isinstance(self._grids[-1][axis], int):
+            g = self._grids[-1][axis]
+            return self._mk(eqn, g, g)
+        return self._mk(eqn, 1, (1 << 31) - 1)
+
+    def _p_get(self, eqn, ins):
+        if not isinstance(ins[0], _RefCell):
+            return self._fallback(eqn, ins)
+        dtype, shape = self._out(eqn)
+        return ins[0].read(dtype, shape)
+
+    def _p_swap(self, eqn, ins):
+        if not isinstance(ins[0], _RefCell):
+            return self._fallback(eqn, ins)
+        cell, val = ins[0], ins[1]
+        dtype, shape = self._out(eqn)
+        old = cell.read(dtype, shape)
+        # a slice whose element count equals the ref's covers the whole
+        # ref (slice extents can never exceed an axis), so the write is
+        # strong; anything smaller joins with the region it left intact
+        numel = 1
+        for d in shape:
+            numel *= d
+        ref_numel = 1
+        for d in cell.shape:
+            ref_numel *= d
+        cell.write(val, full=(numel == ref_numel))
+        return old
+
+    def _p_addupdate(self, eqn, ins):
+        if not isinstance(ins[0], _RefCell):
+            return self._fallback(eqn, ins)
+        cell, val = ins[0], ins[1]
+        old = cell.read(cell.dtype, cell.shape)
+        acc = AbsVal(cell.dtype, cell.shape, old.lo + val.lo,
+                     old.hi + val.hi, exact=old.exact and val.exact)
+        self._check_dtype(eqn, acc)
+        d = np.dtype(cell.dtype)
+        if d.kind in "uib":
+            dlo, dhi = _dtype_range(d)
+            if acc.hi > dhi or acc.lo < dlo:
+                self._flag(eqn, f"{d.name} range exceeded in ref "
+                                f"accumulate: [{acc.lo}, {acc.hi}]")
+        else:
+            exact_max = _FLOAT_EXACT_MAX.get(d.name)
+            if exact_max is not None and \
+                    max(abs(acc.lo), abs(acc.hi)) > exact_max:
+                self._flag(eqn, f"{d.name} exactness lost in ref "
+                                f"accumulate: |result| can reach "
+                                f"{max(abs(acc.lo), abs(acc.hi))}")
+        cell.write(acc, full=False)
+        return []
+
+    def _p_pallas_call(self, eqn, ins):
+        """Interpret the kernel jaxpr (it IS a jaxpr) under the same
+        interval rules, with one _RefCell per input/output/scratch ref
+        and the grid modeled as a join-until-stable fixpoint — VMEM
+        scratch persists across grid steps, so cells carry over exactly
+        like scan carries. Outputs take their cells' stabilized bounds.
+        """
+        p = eqn.params
+        sub = p.get("jaxpr")
+        gm = p.get("grid_mapping")
+        if sub is None or gm is None or \
+                getattr(gm, "num_index_operands", 0):
+            return self._fallback(eqn, ins)
+        if not hasattr(sub, "consts"):
+            sub = jax.core.ClosedJaxpr(sub, ())
+        n_in = gm.num_inputs
+        grid = tuple(gm.grid or ())
+        invars = sub.jaxpr.invars
+        ops_in = ins[len(ins) - n_in:] if n_in else []
+        cells = []
+        for i, var in enumerate(invars):
+            inner = getattr(var.aval, "inner_aval", var.aval)
+            cell = _RefCell(inner.dtype, inner.shape)
+            if i < n_in:
+                v = ops_in[i]
+                cell.val = AbsVal(inner.dtype, inner.shape, v.lo, v.hi,
+                                  exact=v.exact)
+            cells.append(cell)
+        prev_check = self._check
+        self._grids.append(grid)
+        try:
+            for _ in range(_MAX_FIXPOINT_ITERS):
+                self._check = False
+                before = [c.val for c in cells]
+                self.run(sub, list(cells))
+                stable = True
+                for c, b in zip(cells, before):
+                    if c.val is None:
+                        continue
+                    if b is None or not _stable(b, c.val):
+                        stable = False
+                        c.val = c.val if b is None else _join(b, c.val)
+                if stable:
+                    break
+            else:
+                self._check = prev_check
+                self._flag(eqn, "pallas grid fixpoint: ref bounds do "
+                                "not stabilize after "
+                                f"{_MAX_FIXPOINT_ITERS} widening "
+                                "iterations (unbounded accumulation "
+                                "across grid steps)")
+                for c in cells:
+                    lo, hi = _dtype_range(c.dtype)
+                    c.val = AbsVal(c.dtype, c.shape, lo, hi,
+                                   exact=c.dtype.kind != "f")
+            self._check = prev_check
+            self.run(sub, list(cells))
+        finally:
+            self._grids.pop()
+            self._check = prev_check
+        outs = []
+        for i in range(len(eqn.outvars)):
+            dtype, shape = self._out(eqn, i)
+            outs.append(cells[n_in + i].read(dtype, shape))
         return outs
 
     def _loop_fixpoint(self, eqn, body, consts, carry, xs):

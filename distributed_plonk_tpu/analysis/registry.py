@@ -189,6 +189,36 @@ def _msm_entries():
             patches=[(MSM, "_BUCKET_UPDATE", mode),
                      (MSM, "_PLANE_PACK", pack)]))
 
+    # fused Pallas bucket kernel (DPT_MSM_KERNEL=pallas): the
+    # pallas_call kernel jaxpr is interpreted with the SAME interval
+    # rules (bounds._p_pallas_call) — one cell per VMEM plane ref, the
+    # grid as a join-until-stable fixpoint. Registering it here also
+    # covers the in-VMEM RCB15/mont-mul primitives it shares with
+    # curve_pallas/field_pallas (the ROADMAP "Pallas kernels are
+    # outside the bounds pass" gap, first bite). c=7 checks both plane
+    # packings; c=8/c=4 pin the other digit widths.
+    for c, W_, tag, pack in ((7, 37, "c7_packed", True),
+                             (7, 37, "c7", False),
+                             (8, 32, "c8_packed", True)):
+        nb = 1 << (c - 1)
+        out.append(Entry(
+            f"msm/bucket_pallas_signed_{tag}",
+            lambda ax, ay, ainf, d: MSM.bucket_planes_batch_signed(
+                ax, ay, ainf, d, group=1),
+            (limb_rows(24, nc), limb_rows(24, nc),
+             Bound((nc,), jnp.bool_, 0, 1),
+             Bound((Bt, W_, nc), jnp.uint32, 0, 2 * nb - 1)),
+            plane_out,
+            patches=[(MSM, "_MSM_KERNEL", "pallas"),
+                     (MSM, "_PLANE_PACK", pack)]))
+    out.append(Entry(
+        "msm/bucket_pallas_unsigned_c4_packed",
+        lambda ax, ay, ainf, d: MSM.bucket_planes_batch(
+            ax, ay, ainf, d, group=1),
+        uargs, plane_out,
+        patches=[(MSM, "_MSM_KERNEL", "pallas"),
+                 (MSM, "_PLANE_PACK", True)]))
+
     # finish tail (both bucket semantics) + cross-chunk fold
     out.append(Entry(
         "msm/finish_signed_c7",

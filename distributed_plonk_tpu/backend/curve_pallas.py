@@ -84,9 +84,15 @@ def _mod_sub(a, b, n_limbs, p_col):
 
 
 def _band_mul_w(t_ref, a_bytes, b_bytes, w):
-    """field_pallas._band_mul on the leading `w` lanes of the scratch."""
+    """field_pallas._band_mul on the leading `w` lanes of the scratch.
+
+    The zeroing covers the FULL scratch, not just [:, :w]: a partial
+    zero is a weak update to the static verifier's per-ref interval cell
+    (analysis/bounds.py), so stale bounds from a wider prior product
+    would compound across the ~12 products of a fused add and trip the
+    f32-exactness check; the extra lanes cost ~1% of the band FMAs."""
     nb = a_bytes.shape[0]
-    t_ref[:, :w] = jnp.zeros((t_ref.shape[0], w), jnp.float32)
+    t_ref[...] = jnp.zeros(t_ref.shape, jnp.float32)
     for i in range(nb):
         t_ref[i:i + nb, :w] += a_bytes[i][None, :] * b_bytes
     return t_ref[:, :w]
@@ -94,7 +100,7 @@ def _band_mul_w(t_ref, a_bytes, b_bytes, w):
 
 def _band_mul_const_w(t_ref, c_bytes, b_bytes, w):
     nb = b_bytes.shape[0]
-    t_ref[:, :w] = jnp.zeros((t_ref.shape[0], w), jnp.float32)
+    t_ref[...] = jnp.zeros(t_ref.shape, jnp.float32)
     for i, c in enumerate(c_bytes):
         if c == 0:
             continue
@@ -150,9 +156,19 @@ def _mul12(a, k):
 
 # --- the fused kernels -------------------------------------------------------
 
-def _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2, ox_ref, oy_ref, oz_ref):
+def consts_env(kc):
+    """Hashable const tuple (from _fq_consts / fq_consts) -> the dict the
+    value-level helpers consume, with the modulus columns materialized.
+    Exported for kernels that embed these primitives (msm_pallas)."""
+    k = dict(kc)
+    k["negp"] = _col_const(k.pop("negmod_limbs"))
+    k["p_col"] = _col_const(k.pop("mod_limbs"))
+    return k
+
+
+def _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2):
     """Shared tail of RCB15 algorithms 7/8 once (t0, t1, t3, t4, ym) and
-    the b3-scaled t2 are in hand."""
+    the b3-scaled t2 are in hand; returns (x3, y3, z3) i32 values."""
     L, negp, p_col = k["n_limbs"], k["negp"], k["p_col"]
     t0x3 = _mod_add(_mod_add(t0, t0, L, negp), t0, L, negp)
     z3a = _mod_add(t1, t2, L, negp)
@@ -162,25 +178,20 @@ def _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2, ox_ref, oy_ref, oz_ref):
         t_ref,
         [(t4, y3b), (t3, t1a), (y3b, t0x3),
          (t1a, z3a), (t0x3, t3), (z3a, t4)], k)
-    ox_ref[...] = _mod_sub(t2c, x3a, L, p_col).astype(jnp.uint32)
-    oy_ref[...] = _mod_add(t1b, y3c, L, negp).astype(jnp.uint32)
-    oz_ref[...] = _mod_add(z3b, t0c, L, negp).astype(jnp.uint32)
+    return (_mod_sub(t2c, x3a, L, p_col),
+            _mod_add(t1b, y3c, L, negp),
+            _mod_add(z3b, t0c, L, negp))
 
 
-def _add_mixed_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref,
-                      ox_ref, oy_ref, oz_ref, t_ref, *, kc):
-    """Complete projective P + affine Q (RCB15 algorithm 8, a=0): the
-    exact op sequence of curve_jax.proj_add_mixed, in one program."""
-    k = dict(kc)
-    k["negp"] = _col_const(k.pop("negmod_limbs"))
-    k["p_col"] = _col_const(k.pop("mod_limbs"))
+def add_mixed_val(t_ref, k, p, q):
+    """Complete projective P + affine Q (RCB15 algorithm 8, a=0) on
+    in-VMEM (L, w) i32 VALUES — the exact op sequence of
+    curve_jax.proj_add_mixed, width-generic (w is whatever the caller's
+    lane count is; t_ref must be at least 6*w lanes wide). The q_inf /
+    skip select stays with the caller. Returns (x3, y3, z3) values."""
     L, negp, p_col = k["n_limbs"], k["negp"], k["p_col"]
-    x1 = x1_ref[...].astype(jnp.int32)
-    y1 = y1_ref[...].astype(jnp.int32)
-    z1 = z1_ref[...].astype(jnp.int32)
-    x2 = x2_ref[...].astype(jnp.int32)
-    y2 = y2_ref[...].astype(jnp.int32)
-
+    x1, y1, z1 = p
+    x2, y2 = q
     a1 = _mod_add(x1, y1, L, negp)
     a2 = _mod_add(x2, y2, L, negp)
     t0, t1, m3, t4a, y3a = _mm_group(
@@ -189,24 +200,15 @@ def _add_mixed_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref,
     t4 = _mod_add(t4a, y1, L, negp)
     ym = _mod_add(y3a, x1, L, negp)
     t2 = _mul12(z1, k)
-    _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2, ox_ref, oy_ref, oz_ref)
+    return _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2)
 
 
-def _add_full_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref, z2_ref,
-                     ox_ref, oy_ref, oz_ref, t_ref, *, kc):
-    """Complete projective P + Q (RCB15 algorithm 7, a=0): the exact op
-    sequence of curve_jax.proj_add, in one program."""
-    k = dict(kc)
-    k["negp"] = _col_const(k.pop("negmod_limbs"))
-    k["p_col"] = _col_const(k.pop("mod_limbs"))
+def add_full_val(t_ref, k, p, q):
+    """Complete projective P + Q (RCB15 algorithm 7, a=0) on in-VMEM
+    (L, w) i32 values — the exact op sequence of curve_jax.proj_add."""
     L, negp, p_col = k["n_limbs"], k["negp"], k["p_col"]
-    x1 = x1_ref[...].astype(jnp.int32)
-    y1 = y1_ref[...].astype(jnp.int32)
-    z1 = z1_ref[...].astype(jnp.int32)
-    x2 = x2_ref[...].astype(jnp.int32)
-    y2 = y2_ref[...].astype(jnp.int32)
-    z2 = z2_ref[...].astype(jnp.int32)
-
+    x1, y1, z1 = p
+    x2, y2, z2 = q
     t0, t1, t2r, m3, m4, m5 = _mm_group(
         t_ref,
         [(x1, x2), (y1, y2), (z1, z2),
@@ -217,10 +219,38 @@ def _add_full_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref, z2_ref,
     t4 = _mod_sub(m4, _mod_add(t1, t2r, L, negp), L, p_col)
     ym = _mod_sub(m5, _mod_add(t0, t2r, L, negp), L, p_col)
     t2 = _mul12(t2r, k)
-    _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2, ox_ref, oy_ref, oz_ref)
+    return _rcb15_tail(t_ref, k, t0, t1, t3, t4, ym, t2)
 
 
-def _fq_consts():
+def _add_mixed_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref,
+                      ox_ref, oy_ref, oz_ref, t_ref, *, kc):
+    """Complete projective P + affine Q (RCB15 algorithm 8, a=0): the
+    exact op sequence of curve_jax.proj_add_mixed, in one program."""
+    k = consts_env(kc)
+    p = tuple(r[...].astype(jnp.int32) for r in (x1_ref, y1_ref, z1_ref))
+    q = tuple(r[...].astype(jnp.int32) for r in (x2_ref, y2_ref))
+    x3, y3, z3 = add_mixed_val(t_ref, k, p, q)
+    ox_ref[...] = x3.astype(jnp.uint32)
+    oy_ref[...] = y3.astype(jnp.uint32)
+    oz_ref[...] = z3.astype(jnp.uint32)
+
+
+def _add_full_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref, z2_ref,
+                     ox_ref, oy_ref, oz_ref, t_ref, *, kc):
+    """Complete projective P + Q (RCB15 algorithm 7, a=0): the exact op
+    sequence of curve_jax.proj_add, in one program."""
+    k = consts_env(kc)
+    p = tuple(r[...].astype(jnp.int32) for r in (x1_ref, y1_ref, z1_ref))
+    q = tuple(r[...].astype(jnp.int32) for r in (x2_ref, y2_ref, z2_ref))
+    x3, y3, z3 = add_full_val(t_ref, k, p, q)
+    ox_ref[...] = x3.astype(jnp.uint32)
+    oy_ref[...] = y3.astype(jnp.uint32)
+    oz_ref[...] = z3.astype(jnp.uint32)
+
+
+def fq_consts():
+    """Hashable Fq constant tuple for kernels embedding these primitives
+    (jit-static; feed through consts_env inside the kernel body)."""
     from .field_jax import FQ
 
     L = FQ.n_limbs
@@ -231,6 +261,9 @@ def _fq_consts():
              tuple(_const_bytes(int_from_limbs(FQ.mod_limbs), 2 * L))),
             ("negmod_limbs", tuple(int(v) for v in FQ.negmod_limbs)),
             ("mod_limbs", tuple(int(v) for v in FQ.mod_limbs)))
+
+
+_fq_consts = fq_consts  # internal spelling kept for the add kernels below
 
 
 @functools.partial(jax.jit, static_argnums=(0, 1))

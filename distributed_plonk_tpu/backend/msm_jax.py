@@ -129,6 +129,34 @@ def _use_packed_planes():
     return _use_onehot_update() and _PLANE_PACK
 
 
+# Bucket-accumulation kernel (DPT_MSM_KERNEL):
+#   pallas: the fused msm_pallas kernel — digit decode, bucket gather,
+#           RCB15 mixed add, and bucket update in ONE Pallas program
+#           whose bucket planes stay VMEM-resident for the whole point
+#           stream (no per-step HBM plane round trip).
+#   xla:    the lax.scan path below — the parity/debug core, exactly
+#           like DPT_NTT_RADIX=2.
+#   auto (default): pallas on TPU, xla elsewhere (same platform split as
+#   DPT_BUCKET_UPDATE; CPU interpret-mode pallas is test-only).
+# Resolved per call (module attr, monkeypatchable) like _BUCKET_UPDATE;
+# field_jax.pallas_disabled() / mesh.pallas_guard override even a forced
+# "pallas" — a pallas_call has no GSPMD partitioning rule, so sharded
+# traces outside shard_map must keep the XLA scan.
+_MSM_KERNEL = os.environ.get("DPT_MSM_KERNEL", "auto")
+
+
+def _use_pallas_kernel():
+    if getattr(FJ._pallas_off, "v", False):
+        return False
+    if _MSM_KERNEL in ("pallas", "xla"):
+        return _MSM_KERNEL == "pallas"
+    return jax.default_backend() == "tpu"
+
+
+def _kernel_mode():
+    return "pallas" if _use_pallas_kernel() else "xla"
+
+
 # packed-pair layout shared with field_jax (round 3's packed coset evals
 # use the same representation)
 _pack_limbs = FJ.pack_limb_pairs
@@ -182,13 +210,26 @@ def _plane_update(planes, vals, ctx):
 def _group_size_batch(n, batch, c, signed=False):
     """Group width for a B-poly batched MSM: work-optimal size per
     _group_size, further capped so the plane array (which scales with
-    group * B * W * buckets) stays in budget."""
+    group * B * W * buckets) stays in budget.
+
+    Under the fused Pallas kernel the planes live in VMEM, not HBM, so
+    the cap is the VMEM lane budget instead: group shrinks so a window
+    tile of >= ~8 lanes still fits (wider window tiles mean fewer
+    re-reads of the point stream — see msm_pallas's traffic model);
+    per-step overhead no longer rewards huge groups there."""
     w = -(-SCALAR_BITS // c)  # ceil: c=7 has 37 windows, not 36
     buckets = 1 << (c - 1) if signed else 1 << c
-    per_group = 3 * 4 * FQ_LIMBS * batch * w * buckets
     g = _group_size(n)
-    while g > 1 and g * per_group > _PLANE_BYTES_BUDGET:
-        g //= 2
+    if _use_pallas_kernel():
+        from . import msm_pallas
+        cap = max(8, msm_pallas.plane_lanes_cap(
+            buckets, _PLANE_PACK) // 8)
+        while g > cap:
+            g //= 2
+    else:
+        per_group = 3 * 4 * FQ_LIMBS * batch * w * buckets
+        while g > 1 and g * per_group > _PLANE_BYTES_BUDGET:
+            g //= 2
     while g > 1 and n % g != 0:
         g //= 2
     return g
@@ -226,7 +267,15 @@ def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
     uint32 < n_buckets. Returns ((24, group, M, n_buckets),)*3 PROJECTIVE
     planes with bucket b of (group g, lane m) = sum of g's points whose
     lane-m digit == b (bucket 0 included but ignored downstream).
+
+    DPT_MSM_KERNEL=pallas runs the fused VMEM-resident kernel
+    (msm_pallas.bucket_scan) — bit-identical planes at the same group
+    width; this scan remains the parity/debug core.
     """
+    if _use_pallas_kernel():
+        from . import msm_pallas
+        return msm_pallas.bucket_scan(ax, ay, ainf, digits, group,
+                                      n_buckets, packed=_PLANE_PACK)
     M = digits.shape[0]
     sx_all, sy_all = _scan_layout(ax, ay, group)
     xs = (sx_all, sy_all, _to_scan_m(ainf[None, :] | jnp.zeros_like(digits, bool),
@@ -265,7 +314,16 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group, n_buckets=128):
     ax/ay: (24, n) affine Montgomery; ainf: (n,) bool; packed: (M, n)
     uint32 = digit + n_buckets with digit in [-n_buckets, n_buckets-1].
     Returns ((24, group, M, n_buckets),)*3 PROJECTIVE bucket planes.
+
+    DPT_MSM_KERNEL=pallas runs the fused VMEM-resident kernel
+    (msm_pallas.bucket_scan_signed) — bit-identical planes at the same
+    group width; this scan remains the parity/debug core.
     """
+    if _use_pallas_kernel():
+        from . import msm_pallas
+        return msm_pallas.bucket_scan_signed(ax, ay, ainf, packed, group,
+                                             n_buckets,
+                                             packed=_PLANE_PACK)
     M = packed.shape[0]
     off = packed.astype(jnp.int32) - n_buckets
     neg = off < 0
@@ -667,7 +725,10 @@ class MsmContext:
     assert _C_BATCH in (7, 8), f"DPT_MSM_C must be 7 or 8, got {_C_BATCH}"
 
     def _chunk_fn(self, nc, group):
-        key = (nc, group)
+        # keyed on the resolved bucket kernel too: the pallas/xla branch
+        # is taken at TRACE time inside the jit, so an env/attr flip
+        # (bench A/B, tests) must not reuse the other mode's executable
+        key = (nc, group, _kernel_mode())
         if key not in self._chunk_fns:
             fn = bucket_planes_batch_signed if self.signed \
                 else bucket_planes_batch
@@ -690,7 +751,9 @@ class MsmContext:
     _calib_lock = threading.Lock()
 
     def _calib_key(self):
-        return (self._platform, self.signed, self.c_batch)
+        # the fused kernel's adds/s is far from the XLA scan's: a rate
+        # latched under one kernel must not size the other's chunks
+        return (self._platform, self.signed, self.c_batch, _kernel_mode())
 
     def _chunk_lanes(self, B, W):
         """Current per-call point budget (1024-aligned)."""
@@ -717,7 +780,7 @@ class MsmContext:
             # calibrate once, on a WARM shape only: a first call's
             # wall-clock is dominated by XLA compilation and would wildly
             # under-read the device rate
-            warm = self._chunk_calls.get((nc, g), 0) > 0
+            warm = self._chunk_calls.get((nc, g, _kernel_mode()), 0) > 0
             calibrate = (self._calib_key() not in
                          MsmContext._measured_adds_per_s
                          and nc >= 8192 and warm)
@@ -736,7 +799,8 @@ class MsmContext:
                 with MsmContext._calib_lock:
                     MsmContext._measured_adds_per_s.setdefault(
                         self._calib_key(), B * W * nc / dt)
-            self._chunk_calls[(nc, g)] = self._chunk_calls.get((nc, g), 0) + 1
+            ck = (nc, g, _kernel_mode())
+            self._chunk_calls[ck] = self._chunk_calls.get(ck, 0) + 1
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
             i0 += nc
         return self._finish_fn(B)(*acc)
@@ -758,7 +822,17 @@ class MsmContext:
         must be the coefficient-handle widths the caller will commit
         (`warm_stages` passes the prover's n+2/n+3 blinded widths);
         default: this key's full padded width.
-        Returns {"compiled", "failed", "shapes"}."""
+
+        Pallas paths are covered too: with DPT_MSM_KERNEL resolving to
+        pallas, the chunk lowering IS the fused bucket kernel (Mosaic
+        compile, the expensive part of its cold start); and when the
+        fused multiplier gate (field_jax._use_pallas) would route the
+        XLA scan's group products to field_pallas, those multiplier
+        executables are pre-lowered at the scan's 5/6-pair stacked lane
+        widths — closing the PR 3 "Pallas mul path has no AOT hook"
+        remainder.
+        Returns {"compiled", "failed", "shapes", "kernel",
+        "mul_path_widths"}."""
         compiled = failed = 0
         shapes = []
         u32 = jnp.uint32
@@ -779,6 +853,7 @@ class MsmContext:
         for L in sorted({min(w, self.padded_n) for w in digit_widths}):
             aot(self._digits_batch_fn,
                 jax.ShapeDtypeStruct((FR_LIMBS, L), u32))
+        mul_widths = set()
         for B in sorted(set(batch_sizes)):
             nc = min(self._chunk_lanes(B, W), self.padded_n)
             g = _group_size_batch(nc, B, c, signed=self.signed)
@@ -792,8 +867,24 @@ class MsmContext:
                 for _ in range(3))
             aot(self._finish_fn(B), *planes)
             aot(self._merge_fn, planes, planes)
-            shapes.append({"batch": B, "chunk": nc, "group": g})
-        return {"compiled": compiled, "failed": failed, "shapes": shapes}
+            shapes.append({"batch": B, "chunk": nc, "group": g,
+                           "kernel": _kernel_mode()})
+            # the XLA scan's RCB15 add stages its products as 5- and
+            # 6-pair stacked-lane mont_muls at g * B * W lanes; collect
+            # the padded widths the fused multiplier would compile at
+            for pairs in (5, 6):
+                lanes = pairs * g * B * W
+                if FJ._use_pallas((FQ_LIMBS, lanes)):
+                    from . import field_pallas as FP
+                    mul_widths.add(lanes + (-lanes) % FP.LANE_TILE)
+        for Nw in sorted(mul_widths):
+            from . import field_pallas as FP
+            spec = jax.ShapeDtypeStruct((FQ_LIMBS, Nw), u32)
+            aot(FP._mont_mul_flat, "fq",
+                jax.default_backend() != "tpu", FP._VARIANT, spec, spec)
+        return {"compiled": compiled, "failed": failed, "shapes": shapes,
+                "kernel": _kernel_mode(),
+                "mul_path_widths": sorted(mul_widths)}
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""

@@ -60,6 +60,10 @@ log = logging.getLogger("dpt.store")
 
 MANIFEST_VERSION = 1
 
+# store-owned JAX persistent compile cache subdir (warmstart.py parks
+# the cache here; this module garbage-collects it against the budget)
+JAX_CACHE_SUBDIR = "jax_cache"
+
 
 class _FileLock:
     """Advisory exclusive lock on a sidecar file (blocking). Serializes
@@ -108,6 +112,18 @@ class ArtifactStore:
         with self._file_lock:
             self._manifest = self._load_manifest()
             self._sweep_orphans()
+        # the store-owned JAX compile cache counts against the SAME byte
+        # budget (ROADMAP: it used to grow unbounded); swept at open and
+        # then periodically from put()
+        self._jax_sweep_interval = float(
+            os.environ.get("DPT_STORE_JAX_SWEEP_S", "300"))
+        self._jax_cache_bytes = 0
+        # unconditional at open (NOT the throttled wrapper: on a freshly
+        # booted machine monotonic() < interval and a 0.0 sentinel would
+        # suppress the open-time bound entirely)
+        self._last_jax_sweep = time.monotonic()
+        with self._lock:
+            self._sweep_jax_cache_locked()
         self._publish_gauges()
 
     # -- manifest -------------------------------------------------------------
@@ -199,8 +215,13 @@ class ArtifactStore:
     def stats(self):
         with self._lock:
             ents = self._manifest["entries"]
+            # jax_cache_bytes is the total gauged by the last sweep/walk,
+            # not a fresh walk: stats() sits on the METRICS poll path and
+            # a per-poll os.walk of a few thousand compile-cache files
+            # under self._lock would stall concurrent put()/get()
             return {"entries": len(ents),
                     "bytes": sum(e["bytes"] for e in ents.values()),
+                    "jax_cache_bytes": self._jax_cache_bytes,
                     "byte_budget": self.byte_budget}
 
     def meta(self, key):
@@ -245,6 +266,7 @@ class ArtifactStore:
                 self.metrics.inc("put_bytes", len(blob))
                 self._evict_over_budget(protect=key)
                 self._save_manifest()
+            self._maybe_sweep_jax_cache()
             self._publish_gauges()
         return digest
 
@@ -338,6 +360,79 @@ class ArtifactStore:
             os.remove(self._obj_path(digest))
         except OSError:
             pass
+
+    # -- jax compile-cache GC (ROADMAP: count jax_cache against the
+    #    budget) ---------------------------------------------------------------
+
+    def _jax_cache_files(self):
+        """[(path, mtime, size)] of every file under the store-owned JAX
+        persistent compile cache (all machine-fingerprint partitions)."""
+        root = os.path.join(self.root, JAX_CACHE_SUBDIR)
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            for fname in files:
+                path = os.path.join(dirpath, fname)
+                try:
+                    st = os.stat(path)
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    continue
+                out.append((path, st.st_mtime, st.st_size))
+        return out
+
+    def jax_cache_bytes(self):
+        """Fresh walk of the compile-cache tree (also refreshes the total
+        that stats() reports without walking)."""
+        with self._lock:
+            total = sum(s for _, _, s in self._jax_cache_files())
+            self._jax_cache_bytes = total
+            self.metrics.gauge("jax_cache_bytes", total)
+            return total
+
+    def sweep_jax_cache(self):
+        """Bound the store-owned JAX compile cache: artifact entries plus
+        compiled executables share ONE `byte_budget`, with the compile
+        cache yielding first (its blobs are deterministic recompiles,
+        cheaper to lose than a trusted-setup key). Eviction is
+        oldest-mtime first — the cache is content-keyed and written
+        once, so mtime order IS insertion order. Returns files removed.
+        Lock-free across processes by design: a concurrent sweeper
+        deleting the same file is a tolerated ENOENT, and jax treats a
+        missing cache entry as a plain miss."""
+        with self._lock:
+            return self._sweep_jax_cache_locked()
+
+    def _sweep_jax_cache_locked(self):
+        files = sorted(self._jax_cache_files(), key=lambda f: f[1])
+        total = sum(s for _, _, s in files)
+        removed = 0
+        if self.byte_budget is not None:
+            ents = self._manifest["entries"]
+            allowed = self.byte_budget - sum(
+                e["bytes"] for e in ents.values())
+            for path, _mtime, size in files:
+                if total <= max(allowed, 0):
+                    break
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover - concurrent sweep/use
+                    continue
+                total -= size
+                removed += 1
+                self.metrics.inc("jax_cache_evictions")
+        self._jax_cache_bytes = total
+        self.metrics.gauge("jax_cache_bytes", total)
+        return removed
+
+    def _maybe_sweep_jax_cache(self):
+        """Throttled sweep (DPT_STORE_JAX_SWEEP_S, default 300 s):
+        put() calls this so a serving process periodically re-bounds the
+        compile cache without a dedicated timer thread. Callers hold
+        self._lock."""
+        now = time.monotonic()
+        if now - self._last_jax_sweep < self._jax_sweep_interval:
+            return
+        self._last_jax_sweep = now
+        self._sweep_jax_cache_locked()
 
     def _evict_over_budget(self, protect=None):
         if self.byte_budget is None:

@@ -20,8 +20,7 @@ import os
 import time
 
 from . import keycache
-
-JAX_CACHE_SUBDIR = "jax_cache"
+from .artifacts import JAX_CACHE_SUBDIR  # one name for the GC'd subdir
 
 
 def set_jax_cache_env(store_root):
@@ -91,4 +90,8 @@ def warm_spec(store, spec_obj, backend=None, aot_backend=None):
                "domain_size": vk.domain_size, "build_s": round(build_s, 6)}
     if aot_backend is not None:
         out["aot"] = aot_warmup(aot_backend, vk.domain_size, ck=pk.ck)
+        # the AOT pass is what grows the store-owned compile cache:
+        # re-bound it against the byte budget right after (the periodic
+        # put()-side sweep only runs while artifacts are being written)
+        out["jax_cache_swept"] = store.sweep_jax_cache()
     return out

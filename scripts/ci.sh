@@ -26,7 +26,8 @@ if [ "$1" = "fast" ]; then
     --only lint --strict -q || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ntt_jax.py tests/test_curve_msm_jax.py \
-    tests/test_msm_update_paths.py tests/test_poly.py \
+    tests/test_msm_update_paths.py tests/test_msm_pallas.py \
+    tests/test_poly.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -89,6 +89,36 @@ def test_declared_output_bound_is_enforced():
     assert v and any(x.prim == "output" for x in v)
 
 
+def test_mutant_pallas_stale_scratch_is_caught(monkeypatch):
+    """Inside the fused bucket kernel's pallas_call jaxpr: dropping the
+    group-product scratch zeroing (stale f32 columns accumulate across
+    the ~12 products of an add AND across grid steps) must be flagged —
+    the interpreter enters the kernel jaxpr, models the VMEM refs as
+    interval cells, and runs the grid to a fixpoint."""
+    import jax.numpy as jnp_
+    from distributed_plonk_tpu.backend import curve_pallas as CP
+
+    def band_no_zero(t_ref, a_bytes, b_bytes, w):  # MUTANT: no reset
+        nb = a_bytes.shape[0]
+        for i in range(nb):
+            t_ref[i:i + nb, :w] += a_bytes[i][None, :] * b_bytes
+        return t_ref[:, :w]
+
+    monkeypatch.setattr(CP, "_band_mul_w", band_no_zero)
+    entry = next(e for e in R.build_registry()
+                 if e.name == "msm/bucket_pallas_signed_c7_packed")
+    # the kernel wrapper is a module-level jit: drop its cached traces so
+    # the mutant actually traces here and the clean suite re-traces after
+    import jax
+    jax.clear_caches()
+    try:
+        v = entry.check(strict=True)
+    finally:
+        jax.clear_caches()
+    assert v and any("exactness" in x.message or "stabilize" in x.message
+                     or "range exceeded" in x.message for x in v)
+
+
 # --- AST lint mutants ---------------------------------------------------------
 
 _LOCK_MUTANT = '''
@@ -206,6 +236,7 @@ def test_repo_lints_clean():
     ("field/fr_mont_mul", "field/carry_sweep", "field/fr_add"),
     ("ntt/n32_radix4_inv0_coset1_mont", "ntt/n32_radix2"),
     ("msm/digits_signed_c7_L66", "msm/bucket_scan_signed_onehot_packed"),
+    ("msm/bucket_pallas_signed_c7_packed",),
     ("curve/proj_add",),
 ])
 def test_registry_subset_clean(subset):

@@ -41,6 +41,22 @@ def test_jax_prove_verifies_and_matches_oracle(proven):
 
 
 @pytest.mark.slow
+def test_jax_prove_msm_pallas_byte_identical(proven, monkeypatch):
+    """DPT_MSM_KERNEL=pallas (the fused VMEM-resident bucket kernel)
+    produces the SAME proof bytes as the host oracle — and therefore as
+    the default-kernel prove above. Slow tier: every commitment batch
+    recompiles through the interpret-mode Mosaic emulation."""
+    from distributed_plonk_tpu import proof_io
+    from distributed_plonk_tpu.backend import msm_jax
+
+    ckt, pk, vk, proof_host = proven
+    monkeypatch.setattr(msm_jax, "_MSM_KERNEL", "pallas")
+    proof_pl = prove(random.Random(1), ckt, pk, JaxBackend())
+    assert (proof_io.serialize_proof(proof_pl)
+            == proof_io.serialize_proof(proof_host))
+
+
+@pytest.mark.slow
 def test_jax_prove_radix2_byte_identical(proven, monkeypatch):
     """DPT_NTT_RADIX=2 (the parity/debug core) produces the SAME proof
     bytes as the host oracle — and therefore as the default radix-4

@@ -114,6 +114,30 @@ def test_mesh_commit_paths_never_dispatch_pallas(mesh8, monkeypatch):
     assert not hits, f"pallas dispatched in the cross-chunk merge: {hits}"
 
 
+@pytest.mark.slow
+def test_mesh_msm_pallas_kernel_parity(mesh8, monkeypatch):
+    """The per-shard bucket scans inside the mesh MSM pick up
+    DPT_MSM_KERNEL=pallas unchanged (shard_map bodies see per-device
+    local shapes, where a pallas_call is legal), and the folded result
+    matches the XLA-kernel mesh run. On the CPU test mesh pallas_guard
+    would veto the kernel (it exists to keep Mosaic off non-TPU
+    meshes), so the guard is opened and the kernel runs interpret-mode
+    — the same dispatch seam a TPU mesh exercises compiled."""
+    import contextlib
+    from distributed_plonk_tpu.backend import msm_jax as MJ
+    from distributed_plonk_tpu.parallel import msm_mesh as MM
+
+    n = 32
+    bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD)) for _ in range(n)]
+    scalars = [RNG.randrange(R_MOD) for _ in range(n)]
+    want = MeshMsmContext(mesh8, bases).msm(scalars)
+    assert want == C.g1_msm(bases, scalars)
+    monkeypatch.setattr(MJ, "_MSM_KERNEL", "pallas")
+    monkeypatch.setattr(MM, "pallas_guard",
+                        lambda mesh: contextlib.nullcontext())
+    assert MeshMsmContext(mesh8, bases).msm(scalars) == want
+
+
 def test_mesh_msm_matches_oracle(mesh8):
     n = 64
     bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD)) for _ in range(n - 2)]

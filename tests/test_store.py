@@ -166,6 +166,69 @@ def test_just_written_entry_survives_tiny_budget(tmp_path):
     assert store.get("big") is not None  # never evict the entry just put
 
 
+# --- jax compile-cache GC (shared byte budget) -------------------------------
+
+def _fake_jax_cache(root, sizes):
+    """Files under <root>/jax_cache/<fp>/ with staged mtimes (oldest
+    first), mirroring the per-machine-fingerprint layout."""
+    import os
+    import time as _time
+    d = os.path.join(str(root), "jax_cache", "fp0")
+    os.makedirs(d, exist_ok=True)
+    now = _time.time()
+    paths = []
+    for i, size in enumerate(sizes):
+        p = os.path.join(d, f"exe{i}.bin")
+        with open(p, "wb") as f:
+            f.write(bytes(size))
+        os.utime(p, (now - 1000 + i, now - 1000 + i))
+        paths.append(p)
+    return paths
+
+
+def test_jax_cache_counts_against_budget_oldest_first(tmp_path):
+    import os
+    metrics = Metrics()
+    store = ArtifactStore(str(tmp_path), byte_budget=300,
+                          metrics=metrics.scoped("store"))
+    store.put("key", bytes(100))
+    paths = _fake_jax_cache(tmp_path, [100, 100, 100])  # 100 + 300 > 300
+    # stats() reports the last-gauged total (no walk on the poll path);
+    # the explicit accessor walks and refreshes it
+    assert store.jax_cache_bytes() == 300
+    assert store.stats()["jax_cache_bytes"] == 300
+    removed = store.sweep_jax_cache()
+    # artifact bytes (100) leave 200 for the cache: the OLDEST file goes
+    assert removed == 1
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    # the manifest entry is untouched — executables yield before keys
+    assert store.get("key") is not None
+    assert metrics.snapshot()["counters"]["store_jax_cache_evictions"] == 1
+
+
+def test_jax_cache_swept_on_open_and_put(tmp_path):
+    import os
+    _fake_jax_cache(tmp_path, [200, 200])
+    store = ArtifactStore(str(tmp_path), byte_budget=250)
+    # open-time sweep already bounded the cache
+    assert store.stats()["jax_cache_bytes"] <= 250
+    # a put() past the throttle window re-sweeps: shrink the budget's
+    # free share by writing artifacts, with the throttle disabled
+    store._jax_sweep_interval = 0.0
+    store.put("a", bytes(200))
+    assert store.stats()["jax_cache_bytes"] <= 50
+    assert store.get("a") is not None
+
+
+def test_jax_cache_untouched_without_budget(tmp_path):
+    import os
+    paths = _fake_jax_cache(tmp_path, [1 << 20])
+    store = ArtifactStore(str(tmp_path))  # no budget: GC disabled
+    assert store.sweep_jax_cache() == 0
+    assert os.path.exists(paths[0])
+
+
 # --- warm start across processes ---------------------------------------------
 
 def test_second_cache_instance_hits_disk_skips_build(tmp_path, monkeypatch):
