@@ -564,6 +564,105 @@ def service_roundtrip_main():
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
+def fleet_chaos_main():
+    """The fault-domain regression canary: run one fully distributed prove
+    (3 python-backend worker processes over real TCP, sharded 4-step FFTs
+    + range-sharded MSM) with a worker KILLED mid-FFT1 by the chaos
+    injector, and check the recovered proof is byte-identical to the host
+    oracle's. Prints one JSON line ({fleet_chaos_proof_ok,
+    fleet_recoveries, ...}); entirely jax-free."""
+    import random as _random
+    import shutil
+    import tempfile
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.runtime import protocol
+    from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                          RemoteBackend,
+                                                          WorkerHandle)
+    from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
+    from distributed_plonk_tpu.runtime.health import LivenessTracker
+    from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+    from distributed_plonk_tpu.service.jobs import JobSpec, build_circuit, \
+        build_bucket_keys
+    from distributed_plonk_tpu.service.metrics import Metrics
+
+    spec = JobSpec.from_wire({"kind": "toy", "gates": 16, "seed": 7})
+    ckt = build_circuit(spec)
+    _srs, pk, _vk = build_bucket_keys(spec)
+    proof_host = prove(_random.Random(1), ckt, pk, PythonBackend())
+
+    n_workers = 3
+    base = 28500 + (os.getpid() % 450) * (n_workers + 1)
+    cfg = NetworkConfig([f"127.0.0.1:{base + i}" for i in range(n_workers)])
+    tmp = tempfile.mkdtemp(prefix="dpt-bench-fleet-")
+    cfg_path = os.path.join(tmp, "network.json")
+    cfg.save(cfg_path)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
+         str(i), cfg_path, "--backend", "python"], cwd=REPO)
+        for i in range(n_workers)]
+    t0 = time.perf_counter()
+    d = None
+    try:
+        # readiness via tracker-free probes (tests' Fleet.wait_up idiom):
+        # waiting through the breaker-armed dispatcher would record the
+        # slow-startup dials as failures, open breakers (k=2), and then
+        # fast-fail ping() until the deadline burns the whole 30 s
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(WorkerHandle(h, p).probe(timeout_ms=2000) is not None
+                   for h, p in cfg.workers):
+                break
+            time.sleep(0.2)
+        metrics = Metrics()
+        faults = FaultInjector(
+            [Rule("kill", tag=protocol.FFT1, worker=1, nth=1)],
+            kill_cb=lambda i: (procs[i].kill(), procs[i].wait(timeout=10)),
+            metrics=metrics)
+        d = Dispatcher(cfg, metrics=metrics, faults=faults)
+        # fast failure knobs: the canary must not burn minutes in backoff
+        d.tracker = LivenessTracker(n_workers, breaker_k=2,
+                                    probe_base_s=0.05, probe_max_s=0.5,
+                                    metrics=metrics)
+        for w in d.workers:
+            w.tracker = d.tracker
+            w.RECONNECT_TRIES = 2
+            w.BACKOFF_BASE_S = 0.01
+            w.BACKOFF_MAX_S = 0.05
+        proof = prove(_random.Random(1), ckt, pk,
+                      RemoteBackend(d, dist_fft_min=ckt.n))
+        ctr = metrics.snapshot()["counters"]
+        ok = (proof.opening_proof == proof_host.opening_proof
+              and proof.shifted_opening_proof
+              == proof_host.shifted_opening_proof
+              and proof.wires_poly_comms == proof_host.wires_poly_comms
+              and ctr.get("faults_injected_kill", 0) == 1)
+        recoveries = sum(ctr.get(k, 0) for k in (
+            "fleet_range_adoptions", "fleet_fft_replans",
+            "fleet_fft_degraded", "fleet_reconnects",
+            "fleet_readmissions"))
+        print(json.dumps({
+            "fleet_chaos_proof_ok": bool(ok),
+            "fleet_recoveries": recoveries,
+            "fleet_chaos_s": round(time.perf_counter() - t0, 3),
+            "fleet_chaos_phase": "kill@FFT1",
+            "fleet_chaos_counters": {k: v for k, v in sorted(ctr.items())
+                                     if k.startswith(("fleet_", "faults_"))},
+        }))
+    finally:
+        if d is not None:
+            for w in d.workers:
+                w.close()
+            d.pool.shutdown(wait=False)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # --- outer harness (no jax imports past this line) ---------------------------
 
 def _probe_device(timeout_s):
@@ -679,6 +778,27 @@ def _measure_analysis_clean():
         return {"analysis_clean": False, "analysis_detail": repr(e)}
 
 
+def _measure_fleet_chaos():
+    """Run fleet_chaos_main in a scrubbed-CPU subprocess; returns its keys
+    or {fleet_chaos_proof_ok: False, fleet_chaos_error} — every bench line
+    records whether a distributed prove still survives a mid-FFT worker
+    kill with byte-identical proof bytes. Never fails the bench."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--fleet-chaos"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True, text=True,
+            timeout=int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300")))
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            if line.strip().startswith("{"):
+                return json.loads(line)
+        return {"fleet_chaos_proof_ok": False, "fleet_recoveries": 0,
+                "fleet_chaos_error":
+                    f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:
+        return {"fleet_chaos_proof_ok": False, "fleet_recoveries": 0,
+                "fleet_chaos_error": repr(e)}
+
+
 def _measure_service_roundtrip():
     """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
     keys, or {service_error} — the bench line never fails on it."""
@@ -705,6 +825,9 @@ def main():
     if "--service-roundtrip" in sys.argv:
         service_roundtrip_main()
         return
+    if "--fleet-chaos" in sys.argv:
+        fleet_chaos_main()
+        return
     try:
         os.remove(_PARTIAL)
     except OSError:
@@ -721,6 +844,7 @@ def main():
         # service cold/warm round-trips; both still overlap the device
         # measurement
         svc_box.update(_measure_service_roundtrip())
+        svc_box.update(_measure_fleet_chaos())
         svc_box.update(_measure_analysis_clean())
 
     svc_thread = threading.Thread(target=_side_measurements, daemon=True)
@@ -729,10 +853,15 @@ def main():
     def svc():
         svc_thread.join(
             timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300"))
+            + int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
             + int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")) + 30)
         out = dict(svc_box)
         if not any(k.startswith("service") for k in out):
             out["service_error"] = "service roundtrip did not finish"
+        if "fleet_chaos_proof_ok" not in out:
+            out["fleet_chaos_proof_ok"] = False
+            out["fleet_recoveries"] = 0
+            out["fleet_chaos_error"] = "did not finish"
         if "analysis_clean" not in out:
             out["analysis_clean"] = False
             out["analysis_detail"] = "did not finish"

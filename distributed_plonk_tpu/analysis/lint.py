@@ -48,7 +48,9 @@ _PKG = os.path.join(_REPO, "distributed_plonk_tpu")
 # jit-cache lints run here
 KERNEL_DIRS = ("backend", "parallel", "runtime")
 # modules with cross-thread shared state: the lock lint runs here
-LOCK_DIRS = ("service", "store")
+# (runtime/ added with the fleet fault domain: LivenessTracker state,
+# WorkerState task tables, peer-connection caches are all cross-thread)
+LOCK_DIRS = ("service", "store", "runtime")
 
 # mutating container-method names treated as writes by LOCK01 (calls on
 # self.<attr>.<name>(...)); read-only or thread-safe APIs (queue.put,

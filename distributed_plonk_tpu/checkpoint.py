@@ -28,12 +28,17 @@ Design notes:
 """
 
 import hashlib
+import io
 import json
+import logging
 import os
+import zipfile
 
 import numpy as np
 
 from .transcript import g1_to_bytes_compressed, fr_to_bytes
+
+log = logging.getLogger("dpt.checkpoint")
 
 
 def workload_fingerprint(vk, pub_input):
@@ -93,6 +98,86 @@ def _restore_transcript(transcript, snap):
     s.cur_flags = snap["cur_flags"]
 
 
+# -- snapshot <-> bytes codec (shared by the file and store backends) --------
+
+def encode_snapshot(round_no, fingerprint, rng, transcript, arrays, meta):
+    """One self-contained npz blob for a completed round.
+
+    arrays: {name: host numpy array} (poly handle dumps);
+    meta: JSON-able dict (commitments, evaluations) for this round.
+    """
+    rng_state = rng.getstate()
+    manifest = {
+        "round": round_no,
+        "fingerprint": fingerprint,
+        "transcript": _transcript_state(transcript),
+        # Mersenne-Twister state: (version, 625 ints, gauss_next)
+        "rng": [rng_state[0], list(rng_state[1]), rng_state[2]],
+        "meta": meta,
+    }
+    buf = io.BytesIO()
+    np.savez(buf, __manifest__=np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8), **arrays)
+    return buf.getvalue()
+
+
+def decode_snapshot(blob, fingerprint, origin="<blob>"):
+    """Blob -> {round, arrays, meta, rng_state, transcript} state dict.
+
+    Raises ValueError on a fingerprint mismatch (wrong circuit/keys: the
+    caller must NOT silently rebuild over someone else's snapshot).
+    Returns None on structural damage (truncated/bit-flipped npz, missing
+    manifest) — a corrupt snapshot is a missing snapshot, never a crash:
+    the prove restarts from round 1 and, with a seeded RNG, still emits
+    byte-identical proof bytes.
+    """
+    try:
+        with np.load(io.BytesIO(blob)) as z:
+            manifest = json.loads(bytes(z["__manifest__"]).decode())
+            arrays = {k: z[k] for k in z.files if k != "__manifest__"}
+        rng_state = (manifest["rng"][0], tuple(manifest["rng"][1]),
+                     manifest["rng"][2])
+        state = {
+            "round": manifest["round"],
+            "arrays": arrays,
+            "meta": manifest["meta"],
+            "rng_state": rng_state,
+            "transcript": manifest["transcript"],
+        }
+        fp = manifest["fingerprint"]
+    except (zipfile.BadZipFile, OSError, KeyError, json.JSONDecodeError,
+            IndexError, TypeError, ValueError) as e:
+        # ValueError here is np.load/json structural damage; the
+        # fingerprint-mismatch ValueError is raised BELOW, outside this try
+        log.warning("checkpoint %s undecodable (%s); treating as absent",
+                    origin, e)
+        return None
+    if fp != fingerprint:
+        raise ValueError(
+            "checkpoint %s was written for a different circuit/keys "
+            "(fingerprint %s != %s)" % (origin, fp, fingerprint))
+    return state
+
+
+def _flip_middle_byte(path):
+    """Chaos plane (runtime/faults.py corrupt_ckpt): XOR one byte at the
+    midpoint of `path`, under whatever integrity layer guards it. True
+    iff there were bytes to flip."""
+    try:
+        with open(path, "r+b") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if not size:
+                return False
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return True
+    except OSError:
+        return False
+
+
 class ProverCheckpoint:
     """Round-boundary checkpoint store backed by one .npz file.
 
@@ -112,50 +197,30 @@ class ProverCheckpoint:
     # -- write ---------------------------------------------------------------
 
     def save(self, round_no, fingerprint, rng, transcript, arrays, meta):
-        """Persist a completed round atomically.
-
-        arrays: {name: host numpy array} (poly handle dumps);
-        meta: JSON-able dict (commitments, evaluations) for this round.
-        """
-        rng_state = rng.getstate()
-        manifest = {
-            "round": round_no,
-            "fingerprint": fingerprint,
-            "transcript": _transcript_state(transcript),
-            # Mersenne-Twister state: (version, 625 ints, gauss_next)
-            "rng": [rng_state[0], list(rng_state[1]), rng_state[2]],
-            "meta": meta,
-        }
+        """Persist a completed round atomically."""
+        blob = encode_snapshot(round_no, fingerprint, rng, transcript,
+                               arrays, meta)
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, __manifest__=np.frombuffer(
-                json.dumps(manifest).encode(), dtype=np.uint8), **arrays)
+            f.write(blob)
         os.replace(tmp, self.path)
 
     # -- read ----------------------------------------------------------------
 
     def load(self, fingerprint):
         """Return {round, arrays, meta, rng_state, transcript_snap} for the
-        stored snapshot, or None if no checkpoint file exists. Raises
+        stored snapshot, or None if no (readable) checkpoint exists — a
+        damaged file is deleted so the rerun restarts cleanly. Raises
         ValueError on a fingerprint mismatch (wrong circuit/keys)."""
-        if not os.path.exists(self.path):
+        try:
+            with open(self.path, "rb") as f:
+                blob = f.read()
+        except OSError:
             return None
-        with np.load(self.path) as z:
-            manifest = json.loads(bytes(z["__manifest__"]).decode())
-            arrays = {k: z[k] for k in z.files if k != "__manifest__"}
-        if manifest["fingerprint"] != fingerprint:
-            raise ValueError(
-                "checkpoint %s was written for a different circuit/keys "
-                "(fingerprint %s != %s)" % (
-                    self.path, manifest["fingerprint"], fingerprint))
-        return {
-            "round": manifest["round"],
-            "arrays": arrays,
-            "meta": manifest["meta"],
-            "rng_state": (manifest["rng"][0], tuple(manifest["rng"][1]),
-                          manifest["rng"][2]),
-            "transcript": manifest["transcript"],
-        }
+        state = decode_snapshot(blob, fingerprint, origin=self.path)
+        if state is None:
+            self.clear()
+        return state
 
     def restore_into(self, state, rng, transcript):
         """Rewind rng + transcript to the snapshot point."""
@@ -167,3 +232,65 @@ class ProverCheckpoint:
             os.remove(self.path)
         except FileNotFoundError:
             pass
+
+    def chaos_corrupt(self):
+        """Fault injection: flip one byte mid-file. Returns True if there
+        was a snapshot to corrupt. The next load() must detect the
+        damage and restart the prove."""
+        return _flip_middle_byte(self.path)
+
+
+class StoreCheckpoint(ProverCheckpoint):
+    """Round-boundary checkpoints as content-addressed store artifacts.
+
+    Same wire format as the file backend (`encode_snapshot` npz bytes),
+    persisted via `store.ArtifactStore` under `ckpt:<name>` — so prover
+    checkpoints share the store's single durability surface: SHA-256
+    integrity on every read (a bit-flipped snapshot is a detected miss,
+    not a resumed-garbage prove), the one LRU byte budget, and the
+    STORE_FETCH wire tag. A replacement worker on a FRESH host fetches
+    the blob from the dispatcher/a peer (store/remote.py) and resumes the
+    prove mid-flight instead of restarting it — cross-host resume is a
+    network copy (tests/test_runtime_faults.py pins byte-identity).
+    """
+
+    def __init__(self, store, name):
+        super().__init__(path=None)
+        self.store = store
+        self.key = name if name.startswith("ckpt:") else f"ckpt:{name}"
+
+    def save(self, round_no, fingerprint, rng, transcript, arrays, meta):
+        blob = encode_snapshot(round_no, fingerprint, rng, transcript,
+                               arrays, meta)
+        self.store.put(self.key, blob,
+                       meta={"kind": "prover_ckpt", "round": round_no,
+                             "fingerprint": fingerprint})
+
+    def load(self, fingerprint):
+        blob = self.store.get(self.key)  # integrity-verified; corrupt=None
+        if blob is None:
+            return None
+        state = decode_snapshot(blob, fingerprint, origin=self.key)
+        if state is None:  # parse damage below the SHA's radar (stale fmt)
+            self.clear()
+        return state
+
+    def clear(self):
+        self.store.delete(self.key)
+
+    def chaos_corrupt(self):
+        """Flip a byte in the backing object file (the store's SHA-256
+        must catch it on the next get). Returns True if a snapshot
+        existed. Reaches into the store's object layout deliberately —
+        corruption is injected UNDER the integrity layer being tested."""
+        e = self.store.meta(self.key)
+        if e is None:
+            return False
+        digest = None
+        with self.store._lock:  # analysis: ok(chaos hook corrupts beneath the API on purpose)
+            ent = self.store._manifest["entries"].get(self.key)
+            if ent is not None:
+                digest = ent["digest"]
+        if digest is None:
+            return False
+        return _flip_middle_byte(self.store._obj_path(digest))

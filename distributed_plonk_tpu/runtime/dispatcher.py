@@ -7,6 +7,19 @@ dispatcher2.rs:834-893), with the sharding convention fixed: every worker
 receives exactly the base chunk its scalar range covers (the reference
 mixed v1 full-broadcast with v2 chunking and indexed out of bounds —
 SURVEY.md §2.3.1).
+
+Fault domain (the reference treats every worker failure as an unwrap
+panic, src/worker.rs:303): every dispatcher->worker call runs behind a
+reconnect loop with exponential backoff + jitter, a per-worker circuit
+breaker (runtime/health.py) fast-fails calls to a worker that has died so
+its ranges get adopted instead of timing out, half-open probes re-admit a
+worker that comes back, the sharded 4-step FFT re-plans around deaths at
+ANY protocol phase (mirroring `_recover_msm`), and a deterministic fault
+injector (runtime/faults.py) can be threaded through every frame for
+chaos testing. Failure counters land in the duck-typed `metrics` registry
+(service.metrics.Metrics shape): fleet_reconnects, fleet_backoff_waits,
+fleet_breaker_opens, fleet_range_adoptions, fleet_readmissions,
+fleet_fft_replans, fleet_fft_degraded.
 """
 
 import concurrent.futures as futures
@@ -14,10 +27,13 @@ import os
 import random
 import struct
 import threading
+import time
 
 import numpy as np
 
 from . import native, protocol
+from .faults import FaultInjector
+from .health import LivenessTracker, NullMetrics
 from .. import curve as C
 from ..backend.python_backend import PythonBackend
 
@@ -43,62 +59,233 @@ def _try(fn, arg):
         return _Failure(e)
 
 
+class WorkerUnavailable(ConnectionError):
+    """Fast-fail for a breaker-open worker: no dial, no timeout burned."""
+
+
+class FleetError(RuntimeError):
+    """A distributed protocol attempt lost at least one worker."""
+
+
 class WorkerHandle:
-    """One framed connection to a worker, with a per-call timeout and one
-    reconnect-retry — the failure handling the reference never had (every
-    RPC there is .unwrap(), SURVEY.md §5: a worker crash hangs the prove).
+    """One framed connection to a worker, with a per-call timeout and a
+    bounded reconnect loop (exponential backoff + jitter) — replacing the
+    single reconnect-retry of earlier rounds; the reference has neither
+    (every RPC there is .unwrap(), SURVEY.md §5: a worker crash hangs the
+    prove).
 
     A timeout mid-frame desynchronizes the stream, so recovery is always
     reconnect-then-retry, never resend on the same socket. Retried requests
     are idempotent at the worker (MSM/NTT are pure; FFT1/FFT_EXCHANGE
     overwrite the same slots; FFT2 replays its cached reply instead of
-    deleting the task — completed tasks are GC'd by age)."""
+    deleting the task — completed tasks are GC'd by age + LRU cap).
+
+    The connection is LAZY: constructing a handle to a not-yet-alive
+    worker is fine; the first call dials."""
 
     # 0 = block forever; FFT2 on a python-backend worker can take minutes
     TIMEOUT_MS = int(os.environ.get("DPT_CALL_TIMEOUT_MS", "600000"))
+    RECONNECT_TRIES = int(os.environ.get("DPT_RECONNECT_TRIES", "3"))
+    # analysis: ok(host-only ms->s conversion, no traced arithmetic)
+    BACKOFF_BASE_S = float(os.environ.get("DPT_BACKOFF_BASE_MS", "50")) / 1e3
+    # analysis: ok(host-only ms->s conversion, no traced arithmetic)
+    BACKOFF_MAX_S = float(os.environ.get("DPT_BACKOFF_MAX_MS", "2000")) / 1e3
 
-    def __init__(self, host, port):
+    def __init__(self, host, port, index=0, tracker=None, metrics=None,
+                 faults=None):
         self.host, self.port = host, port
-        self.conn = self._connect()
+        self.index = index
+        self.tracker = tracker
+        self.metrics = metrics or NullMetrics()
+        self.faults = faults
+        self.conn = None
         # one in-flight request per connection: frames are not interleavable
         self._lock = threading.Lock()
 
     def _connect(self):
-        conn = native.connect(self.host, self.port)
+        # bound the dial by the call timeout too: a partitioned worker
+        # (dropped SYNs) must cost one timeout, not the OS connect
+        # default of minutes
+        conn = native.connect(self.host, self.port,
+                              timeout_ms=self.TIMEOUT_MS)
         if self.TIMEOUT_MS:
             conn.set_timeout(self.TIMEOUT_MS)
         return conn
 
-    def call(self, tag, payload=b""):
+    def _drop_conn_locked(self):
+        """self._lock held (the reconnect loop's own drop)."""
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+    def drop_conn(self):
+        """Discard the cached stream so the next call dials fresh (the
+        dispatcher's probe/readmit paths know it is or may be stale).
+        Takes the call lock: never closes a socket mid-request."""
         with self._lock:
-            try:
-                self.conn.send(tag, payload)
-                rtag, rpayload = self.conn.recv()
-            except (ConnectionError, OSError):
-                self.conn.close()
-                self.conn = self._connect()  # one retry on a fresh stream
-                self.conn.send(tag, payload)
-                rtag, rpayload = self.conn.recv()
+            self._drop_conn_locked()
+
+    def call(self, tag, payload=b""):
+        """Send one request; reconnect with backoff on transport failure.
+        Raises WorkerUnavailable without dialing when the breaker is open
+        (callers adopt the range / replan instead of burning a timeout),
+        ConnectionError when every reconnect try failed, RuntimeError on
+        an ERR reply (the worker is ALIVE — protocol errors don't count
+        against the breaker)."""
+        if self.tracker is not None and not self.tracker.usable(self.index):
+            raise WorkerUnavailable(f"worker {self.index} breaker open")
+        try:
+            with self._lock:
+                rtag, rpayload = self._call_locked(tag, payload)
+        except (ConnectionError, OSError):
+            if self.tracker is not None:
+                self.tracker.record_failure(self.index)
+            raise
+        if self.tracker is not None:
+            self.tracker.record_ok(self.index)
         if rtag != protocol.OK:
             raise RuntimeError(f"worker error: {rpayload!r}")
         return rpayload
 
+    def _call_locked(self, tag, payload):
+        delay = self.BACKOFF_BASE_S
+        for attempt in range(self.RECONNECT_TRIES):
+            try:
+                if self.conn is None:
+                    self.conn = self._connect()
+                wire_tag = tag
+                if self.faults is not None:
+                    # may sleep (delay), raise InjectedDrop (drop), scramble
+                    # the tag (corrupt), or kill the worker process (kill)
+                    wire_tag = self.faults.on_send(self.index, tag, payload)
+                self.conn.send(wire_tag, payload)
+                return self.conn.recv()
+            except (ConnectionError, OSError):
+                self._drop_conn_locked()
+                if attempt + 1 >= self.RECONNECT_TRIES:
+                    raise
+                # exponential backoff with jitter: a fleet of callers
+                # retrying a flapping worker must not stampede it
+                sleep_s = min(self.BACKOFF_MAX_S, delay) \
+                    * (1.0 + 0.5 * random.random())  # analysis: ok(host-only jitter)
+                delay *= 2
+                self.metrics.inc("fleet_reconnects")
+                self.metrics.inc("fleet_backoff_waits")
+                self.metrics.observe("fleet_backoff", sleep_s)
+                time.sleep(sleep_s)
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+    def probe(self, timeout_ms=5000):
+        """Liveness check on a FRESH short-timeout connection (half-open
+        breaker probe): never touches the cached stream, so a probe racing
+        a real call cannot desynchronize it. Returns the HEALTH snapshot
+        dict, or None when the worker is unreachable."""
+        import json
+        try:
+            # timeout covers the dial as well: probes are the breaker's
+            # fast-fail plane and must never block on a partitioned host
+            conn = native.connect(self.host, self.port,
+                                  timeout_ms=timeout_ms)
+        except (ConnectionError, OSError):
+            return None
+        try:
+            conn.set_timeout(timeout_ms)
+            conn.send(protocol.HEALTH)
+            rtag, rpayload = conn.recv()
+            if rtag != protocol.OK:
+                return None
+            return json.loads(rpayload.decode() or "{}")
+        except (ConnectionError, OSError, ValueError):
+            return None
+        finally:
+            conn.close()
+
     def close(self):
-        self.conn.close()
+        self.drop_conn()
 
 
 class Dispatcher:
-    """Connections to every worker + distributed MSM / NTT offload."""
+    """Connections to every worker + distributed MSM / NTT offload, with
+    liveness tracking, breaker-gated routing, and re-admission probes."""
 
-    def __init__(self, config):
-        self.workers = [WorkerHandle(h, p) for h, p in config.workers]
+    FFT_QUORUM = int(os.environ.get("DPT_FFT_QUORUM", "2"))
+
+    def __init__(self, config, metrics=None, faults=None):
+        self.metrics = metrics or NullMetrics()
+        if faults is None:
+            # env-driven chaos (DPT_FAULTS="drop:tag=NTT;delay:tag=MSM:ms=50")
+            # for soaks against a live deployment; None when unset, so the
+            # hot path stays injection-free
+            faults = FaultInjector.from_env(metrics=self.metrics)
+        self.faults = faults
+        self.tracker = LivenessTracker(len(config.workers),
+                                       metrics=self.metrics)
+        self.workers = [
+            WorkerHandle(h, p, index=i, tracker=self.tracker,
+                         metrics=self.metrics, faults=faults)
+            for i, (h, p) in enumerate(config.workers)]
         self.pool = futures.ThreadPoolExecutor(max_workers=len(self.workers))
         self._ranges = None
+        self._bases = None
         self._adopted = {}  # base-range i -> worker j that adopted it
 
     def ping(self):
         for w in self.workers:
             w.call(protocol.PING)
+
+    def health(self):
+        """Fresh-probe HEALTH snapshot per worker (None = unreachable)."""
+        return [w.probe() for w in self.workers]
+
+    # -- liveness maintenance -------------------------------------------------
+
+    def _probe_fleet(self):
+        """Find out who is ACTUALLY dead after a distributed attempt
+        failed: a worker often reports a peer's death as its own error
+        (FFT2_PREPARE push to a dead peer), so failure attribution needs a
+        direct probe of everyone. Probes run concurrently; dead workers
+        get the breaker opened immediately (authoritative evidence)."""
+        def one(iw):
+            i, w = iw
+            if w.probe() is None:
+                self.tracker.mark_dead(i)
+                w.drop_conn()
+            else:
+                self.tracker.record_ok(i)
+        list(self.pool.map(one, enumerate(self.workers)))
+
+    def _maybe_readmit(self):
+        """Half-open probes for breaker-open workers whose backoff window
+        elapsed; a worker that answers is re-admitted and (if bases are
+        provisioned) gets its original MSM range re-uploaded so routing
+        rebalances instead of leaning on the adopter forever."""
+        for i in self.tracker.due_probes():
+            w = self.workers[i]
+            if w.probe() is None:
+                self.tracker.record_failure(i)
+                continue
+            w.drop_conn()  # stale pre-death stream, if any
+            self.tracker.record_ok(i)  # counts fleet_readmissions
+            self._reprovision(i)
+
+    def _reprovision(self, i):
+        """Best effort: push range i's bases back to a re-admitted worker
+        i and drop the adoption redirect. A failure here is harmless —
+        the lazy recovery path re-adopts at the next msm()."""
+        if self._ranges is None or i >= len(self._ranges):
+            return
+        start, end = self._ranges[i]
+        if end <= start:
+            return
+        try:
+            self.workers[i].call(
+                protocol.INIT_BASES,
+                protocol.encode_init_bases(i, self._bases[start:end]))
+            self._adopted.pop(i, None)
+        except Exception:
+            pass
+
+    # -- MSM ------------------------------------------------------------------
 
     def init_bases(self, bases):
         """Range-shard the SRS: worker i holds bases[start_i:end_i]
@@ -133,6 +320,7 @@ class Dispatcher:
         here a dead worker's range is re-provisioned onto a healthy worker
         and recomputed)."""
         assert self._ranges is not None, "init_bases first"
+        self._maybe_readmit()
 
         def part(i):
             start, end = self._ranges[i]
@@ -165,7 +353,12 @@ class Dispatcher:
     def _recover_msm(self, dead_i, scalars):
         """Re-provision range dead_i's bases onto a healthy worker (set id
         unchanged — ids are ranges, not workers), recompute its part, and
-        REMEMBER the adoption so later msm() calls route directly."""
+        REMEMBER the adoption so later msm() calls route directly. Workers
+        with an open breaker are skipped up front (no timeout burned);
+        only if NO usable worker can adopt are the breaker-open ones
+        probed directly and re-admitted on an answer — same last-resort
+        rule as ntt(): a recovered fleet whose breakers are all still
+        open must serve the call, not abort the prove."""
         start, end = self._ranges[dead_i]
         chunk = scalars[start:end]
         if not chunk:
@@ -173,35 +366,74 @@ class Dispatcher:
         k = len(self.workers)
         failed_owner = self._adopted.get(dead_i, dead_i)
         last_err = None
-        for off in range(1, k + 1):
-            j = (dead_i + off) % k
-            if j == failed_owner:
-                continue
+
+        def adopt(j):
             w = self.workers[j]
+            w.call(protocol.INIT_BASES, protocol.encode_init_bases(
+                dead_i, self._bases[start:end]))
+            raw = w.call(protocol.MSM,
+                         protocol.encode_msm_request(dead_i, chunk))
+            self._adopted[dead_i] = j
+            self.metrics.inc("fleet_range_adoptions")
+            return protocol.decode_point(raw)
+
+        rotation = [(dead_i + off) % k for off in range(1, k + 1)]
+        for j in rotation:
+            if j == failed_owner or not self.tracker.usable(j):
+                continue
             try:
-                w.call(protocol.INIT_BASES, protocol.encode_init_bases(
-                    dead_i, self._bases[start:end]))
-                raw = w.call(protocol.MSM,
-                             protocol.encode_msm_request(dead_i, chunk))
-                self._adopted[dead_i] = j
-                return protocol.decode_point(raw)
+                return adopt(j)
             except Exception as e:  # try the next healthy worker
+                last_err = e
+        for j in self._probe_readmit(
+                j for j in rotation
+                if j != failed_owner and not self.tracker.usable(j)):
+            try:
+                return adopt(j)
+            except Exception as e:
                 last_err = e
         raise RuntimeError(
             f"no healthy worker could adopt MSM range {dead_i}") from last_err
 
+    def _probe_readmit(self, candidates):
+        """Last-resort plane shared by ntt() and _recover_msm(): probe
+        each breaker-open candidate directly and yield the ones that
+        answer (re-admitted) so the caller can route to them — a
+        recovered fleet whose breakers are all still open must serve the
+        call, not fast-fail it (call() alone would raise
+        WorkerUnavailable without dialing)."""
+        for i in candidates:
+            if self.workers[i].probe() is None:
+                continue  # actually dead: leave the breaker open
+            self.tracker.record_ok(i)  # alive: re-admit, then route to it
+            yield i
+
+    # -- NTT ------------------------------------------------------------------
+
     def ntt(self, values, inverse=False, coset=False, worker=0):
         """Offload one whole NTT to a worker (per-polynomial task
         parallelism, reference §2.3.3). NTTs are stateless, so a dead
-        worker is simply routed around: every other worker is tried before
-        giving up."""
+        worker is simply routed around: usable workers are tried first
+        (rotation order); if every one of them fails, breaker-open
+        workers are PROBED directly and re-admitted on an answer — a
+        recovered fleet whose breakers are all still open must serve the
+        call, not fast-fail it (call() alone would raise
+        WorkerUnavailable without dialing)."""
         k = len(self.workers)
         payload = protocol.encode_ntt_request(values, inverse, coset)
+        self._maybe_readmit()
+        rotation = [(worker + off) % k for off in range(k)]
         last_err = None
-        for off in range(k):
+        for i in [i for i in rotation if self.tracker.usable(i)]:
             try:
-                raw = self.workers[(worker + off) % k].call(
-                    protocol.NTT, payload)
+                raw = self.workers[i].call(protocol.NTT, payload)
+                return protocol.decode_scalars(raw)
+            except Exception as e:
+                last_err = e
+        for i in self._probe_readmit(
+                i for i in rotation if not self.tracker.usable(i)):
+            try:
+                raw = self.workers[i].call(protocol.NTT, payload)
                 return protocol.decode_scalars(raw)
             except Exception as e:
                 last_err = e
@@ -215,70 +447,156 @@ class Dispatcher:
             lambda ij: self.ntt(ij[1][0], ij[1][1], ij[1][2], worker=ij[0]),
             enumerate(jobs)))
 
+    # -- sharded 4-step FFT ---------------------------------------------------
+
     def fft_dist(self, values, inverse=False, coset=False):
         """ONE cross-worker sharded 4-step (i)(coset)FFT — the reference's
         hot protocol (Prover::fft, dispatcher2.rs:731-787): stage-1 rows
         scattered block-wise, direct worker<->worker all-to-all, stage-2
         columns gathered. len(values) must be a power of two.
 
-        Host data plane is a (16, n) numpy limb matrix end to end: the
-        row/column restrides are numpy views and every wire payload is one
-        bulk codec call (the per-int Python path was round-2 weakness #8;
-        the reference's analog is ip_transpose around scatter/gather,
-        src/dispatcher.rs:305,332)."""
+        Failure recovery: a worker dying at ANY phase (FFT_INIT / FFT1 /
+        the EXCHANGE all-to-all / FFT2_PREPARE / FFT2) fails the attempt;
+        the fleet is probed to find who actually died (a healthy worker
+        reports a dead PEER's loss as its own error), the dead workers'
+        panel rows and column ranges are re-provisioned onto the healthy
+        subset, and the protocol re-runs under a fresh task id — the FFT
+        mirror of `_recover_msm`, leaning on the worker handlers being
+        idempotent and tasks being GC'd by TTL/cap. When the healthy set
+        shrinks below FFT_QUORUM the call degrades gracefully to the
+        whole-poly single-worker NTT path (which itself routes around
+        dead workers). Byte-identical output either way — the kernels are
+        deterministic and the math doesn't care where it runs."""
         n = len(values)
         assert n >= 4 and n & (n - 1) == 0, n
+        k = len(self.workers)
+        self._maybe_readmit()
+        last_err = None
+        same_set_retry = False
+        for _attempt in range(k + 1):
+            active = self.tracker.usable_set()
+            if len(active) < max(self.FFT_QUORUM, 1):
+                if len(active) < k:
+                    # a fault shrank the fleet below quorum; a CONFIGURED
+                    # sub-quorum fleet (k=1) taking this path is healthy
+                    # and must not read as continuous degradation
+                    self.metrics.inc("fleet_fft_degraded")
+                return self.ntt(values, inverse, coset)
+            try:
+                return self._fft_dist_attempt(values, inverse, coset, active)
+            except (FleetError, ConnectionError, OSError, RuntimeError) as e:
+                last_err = e
+                # attribute the loss: probe everyone, open breakers on the
+                # actually-dead, then replan on the survivors
+                self._probe_fleet()
+                if self.tracker.usable_set() == active:
+                    # nobody actually died: a transient (dropped/corrupt
+                    # frame, one slow call) gets ONE same-set retry; a
+                    # second failure on the unchanged set is a
+                    # deterministic error — surface it instead of burning
+                    # k+1 identical multi-second attempts
+                    if same_set_retry:
+                        raise
+                    same_set_retry = True
+                else:
+                    same_set_retry = False
+                self.metrics.inc("fleet_fft_replans")
+        raise RuntimeError(
+            f"sharded FFT failed after {k + 1} replans") from last_err
+
+    def _fft_dist_attempt(self, values, inverse, coset, active):
+        """One protocol run over the `active` worker subset. Dead workers
+        keep zero-width row/column ranges, so the full-length col_ranges
+        table still indexes by fleet position (peer routing is by config
+        index) while all data lands on the healthy subset."""
+        n = len(values)
         r, c = _split_rc(n)
         k = len(self.workers)
+        a = len(active)
         task_id = random.getrandbits(63)
-        row_bounds = [c * i // k for i in range(k + 1)]
-        col_ranges = [(r * i // k, r * (i + 1) // k) for i in range(k)]
+        arow = [c * j // a for j in range(a + 1)]
+        acol = [r * j // a for j in range(a + 1)]
+        row_bounds = {i: (arow[j], arow[j + 1]) for j, i in enumerate(active)}
+        col_ranges = [(0, 0)] * k
+        for j, i in enumerate(active):
+            col_ranges[i] = (acol[j], acol[j + 1])
 
         # (16, c, r): axis 1 = row index j2 (stride c in the flat poly)
         vm = protocol.ints_to_matrix(values).reshape(16, r, c)
         rows_mat = vm.transpose(0, 2, 1)  # [16, j2, position-in-row]
 
-        list(self.pool.map(
+        def run_phase(fn, targets):
+            failures = [res for res in self.pool.map(lambda i: _try(fn, i),
+                                                     targets)
+                        if isinstance(res, _Failure)]
+            if failures:
+                raise FleetError(
+                    f"fft phase lost {len(failures)} worker(s)") \
+                    from failures[0].err
+
+        run_phase(
             lambda i: self.workers[i].call(
                 protocol.FFT_INIT, protocol.encode_fft_init(
                     task_id, inverse, coset, n, r, c,
-                    row_bounds[i], row_bounds[i + 1], col_ranges)),
-            range(k)))
+                    row_bounds[i][0], row_bounds[i][1], col_ranges)),
+            active)
 
         def scatter(i):
-            rs, re = row_bounds[i], row_bounds[i + 1]
+            rs, re = row_bounds[i]
             if re == rs:
                 return
             panel = np.ascontiguousarray(rows_mat[:, rs:re, :])
             self.workers[i].call(
                 protocol.FFT1, protocol.encode_fft1_matrix(task_id, rs, panel))
 
-        list(self.pool.map(scatter, range(k)))
+        run_phase(scatter, active)
 
         # trigger the all-to-all; each worker's OK implies its slices landed
-        list(self.pool.map(
+        run_phase(
             lambda i: self.workers[i].call(
                 protocol.FFT2_PREPARE, struct.pack("<Q", task_id)),
-            range(k)))
+            active)
 
         def gather(i):
-            return protocol.decode_scalar_matrix(self.workers[i].call(
+            cs, ce = col_ranges[i]
+            if ce == cs:
+                return i, None
+            flat = protocol.decode_scalar_matrix(self.workers[i].call(
                 protocol.FFT2, struct.pack("<Q", task_id)))
+            return i, flat
 
         out = np.empty((16, r, c), dtype=np.uint32)  # [16, k1, k2]
-        for i, flat in enumerate(self.pool.map(gather, range(k))):
+        failures = []
+        for res in self.pool.map(lambda i: _try(gather, i), active):
+            if isinstance(res, _Failure):
+                failures.append(res)
+                continue
+            i, flat = res
+            if flat is None:
+                continue
             cs, ce = col_ranges[i]
-            if ce > cs:
-                out[:, cs:ce, :] = flat.reshape(16, ce - cs, c)
+            out[:, cs:ce, :] = flat.reshape(16, ce - cs, c)
+        if failures:
+            raise FleetError(
+                f"fft gather lost {len(failures)} worker(s)") \
+                from failures[0].err
         # result index is k1 + r*k2 -> transpose to [k2, k1] before flatten
         return protocol.matrix_to_ints(
             np.ascontiguousarray(out.transpose(0, 2, 1)).reshape(16, n))
 
+    # -- misc -----------------------------------------------------------------
+
     def stats(self):
-        """Per-worker served-request counters {tag: count}."""
+        """Per-worker served-request counters {tag: count} ({} for a
+        worker that can't answer)."""
         import json
-        return [json.loads(w.call(protocol.STATS).decode())
-                for w in self.workers]
+
+        def one(w):
+            try:
+                return json.loads(w.call(protocol.STATS).decode())
+            except Exception:
+                return {}
+        return [one(w) for w in self.workers]
 
     def shutdown(self):
         for w in self.workers:

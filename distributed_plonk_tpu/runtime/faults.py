@@ -1,0 +1,209 @@
+"""Deterministic chaos injection for the distributed prover.
+
+One injector object threads through both failure planes:
+
+  wire plane (runtime/dispatcher.py): `on_send(worker, tag, payload)` runs
+      just before every dispatcher->worker frame. Rules select a protocol
+      tag + worker + Nth occurrence (deterministic: the chaos sweep kills a
+      worker at EXACTLY one protocol phase per run) or a probability
+      (loadgen chaos soak). Actions:
+        kill     invoke the registered kill callback (test harness kills
+                 the worker process; a real deploy could fence a pod)
+        drop     raise InjectedDrop (a ConnectionError) without sending —
+                 the frame "was lost"; the handle's reconnect/backoff path
+                 must resend (worker handlers are idempotent)
+        corrupt  scramble the frame TAG so the receiver rejects it loudly
+                 (ERR "unknown tag") — modeling a framing-level corruption
+                 the way the transport can actually detect it; payload
+                 bit-flips below the codec's radar are modeled on the
+                 checkpoint plane instead, where SHA-256 catches them
+        delay    sleep `ms` (slow worker / congested link)
+
+  checkpoint plane (service/pool.py): `on_round(round_no, checkpoint)`
+      runs at every prover round boundary, after the snapshot is durable.
+      Actions:
+        delay         sleep `ms` (slow prover)
+        corrupt_ckpt  flip a byte inside the just-written snapshot
+                      artifact (checkpoint.chaos_corrupt()) — the
+                      integrity layer (SHA-256 in the store, zip/manifest
+                      validation on files) must detect it and restart the
+                      prove cleanly rather than resume garbage
+
+Rules come from code (tests) or from the environment:
+
+    DPT_FAULTS="kill:tag=FFT1:worker=1:nth=1;delay:tag=MSM:ms=50"
+
+Entries are `action[:key=value]*` separated by `;`. Keys: tag (name or
+number), worker, nth (1-based occurrence; default 1), rate (probability,
+overrides nth), ms, max (max fires, default 1 for nth rules, unlimited
+for rate rules). Occurrence counting is per-rule and thread-safe.
+"""
+
+import os
+import random
+import threading
+import time
+
+from . import protocol
+
+
+class InjectedDrop(ConnectionError):
+    """A frame the injector 'lost' before it hit the socket."""
+
+
+# scrambling the tag keeps the frame well-formed but unroutable, so the
+# receiver's reply is a deterministic ERR (unknown tag), never a silently
+# wrong computation
+_CORRUPT_TAG_XOR = 0x40000000
+
+_TAG_NAMES = {name: value for name, value in vars(protocol).items()
+              if name.isupper() and isinstance(value, int)}
+
+
+class Rule:
+    def __init__(self, action, tag=None, worker=None, nth=1, rate=None,
+                 ms=0.0, max_fires=None, plane=None):
+        assert action in ("kill", "drop", "corrupt", "delay", "corrupt_ckpt"), action
+        self.action = action
+        self.tag = tag          # protocol tag int (wire) / round no (round)
+        self.worker = worker    # worker index, or None = any
+        self.nth = nth          # 1-based matching-occurrence to fire on
+        self.rate = rate        # probability per occurrence (overrides nth)
+        self.ms = ms
+        # which hook runs the rule: corrupt_ckpt only makes sense at round
+        # boundaries; everything else defaults to the wire (at=round in the
+        # env spec, or plane="round" in code, moves a delay to the pool)
+        self.plane = plane or ("round" if action == "corrupt_ckpt" else "wire")
+        if max_fires is None:
+            max_fires = None if rate is not None else 1
+        self.max_fires = max_fires
+        self.seen = 0
+        self.fired = 0
+
+    def matches(self, tag=None, worker=None):
+        if self.max_fires is not None and self.fired >= self.max_fires:
+            return False
+        if self.tag is not None and tag != self.tag:
+            return False
+        if self.worker is not None and worker is not None \
+                and worker != self.worker:
+            return False
+        return True
+
+    @classmethod
+    def parse(cls, entry):
+        """'kill:tag=FFT1:worker=1:nth=2' -> Rule."""
+        parts = entry.strip().split(":")
+        action, kvs = parts[0], parts[1:]
+        kw = {}
+        for kv in kvs:
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "tag":
+                kw["tag"] = _TAG_NAMES[v] if v in _TAG_NAMES else int(v)
+            elif k == "worker":
+                kw["worker"] = int(v)
+            elif k == "nth":
+                kw["nth"] = int(v)
+            elif k == "rate":
+                kw["rate"] = float(v)
+            elif k == "ms":
+                kw["ms"] = float(v)
+            elif k == "max":
+                kw["max_fires"] = int(v)
+            elif k == "at":
+                kw["plane"] = v
+            else:
+                raise ValueError(f"unknown fault key {k!r} in {entry!r}")
+        return cls(action, **kw)
+
+
+class FaultInjector:
+    """Holds the rule set + side-effect callbacks; thread-safe.
+
+    kill_cb(worker_index): registered by the harness that owns the worker
+    processes. metrics: duck-typed inc() (service.metrics.Metrics). rng:
+    rate-based decisions (seed it for reproducible soaks).
+    """
+
+    def __init__(self, rules=None, kill_cb=None, metrics=None, rng=None):
+        self.rules = list(rules or [])
+        self.kill_cb = kill_cb
+        self.metrics = metrics
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env_var="DPT_FAULTS", **kwargs):
+        """Injector from the env spec; None when the variable is unset or
+        empty (callers keep a zero-overhead fast path)."""
+        spec = os.environ.get(env_var, "").strip()
+        if not spec:
+            return None
+        rules = [Rule.parse(e) for e in spec.split(";") if e.strip()]
+        return cls(rules, **kwargs)
+
+    def _inc(self, name):
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def _due(self, rule, tag=None, worker=None):
+        """Occurrence bookkeeping under the lock; returns True to fire."""
+        with self._lock:
+            if not rule.matches(tag=tag, worker=worker):
+                return False
+            rule.seen += 1
+            if rule.rate is not None:
+                fire = self._rng.random() < rule.rate
+            else:
+                fire = rule.seen == rule.nth
+            if fire:
+                rule.fired += 1
+            return fire
+
+    # -- wire plane (dispatcher) ----------------------------------------------
+
+    def on_send(self, worker, tag, payload):
+        """Run matching wire rules; returns the (possibly corrupted) tag.
+        May sleep (delay), raise InjectedDrop (drop), or kill the worker
+        out from under the send (kill)."""
+        for rule in self.rules:
+            if rule.plane != "wire":
+                continue
+            if not self._due(rule, tag=tag, worker=worker):
+                continue
+            self._inc(f"faults_injected_{rule.action}")
+            if rule.action == "delay":
+                time.sleep(rule.ms / 1000.0)  # analysis: ok(host-only ms->s)
+            elif rule.action == "drop":
+                raise InjectedDrop(
+                    f"injected drop of tag {tag} to worker {worker}")
+            elif rule.action == "corrupt":
+                tag = tag ^ _CORRUPT_TAG_XOR
+            elif rule.action == "kill":
+                if self.kill_cb is not None:
+                    self.kill_cb(worker)
+        return tag
+
+    # -- checkpoint plane (prover pool) ---------------------------------------
+
+    def on_round(self, round_no, checkpoint=None):
+        """Round-boundary hook: `tag` in rules is interpreted as the round
+        number here (tag=2 -> after round 2), None = every round."""
+        for rule in self.rules:
+            if rule.plane != "round":
+                continue
+            if not self._due(rule, tag=round_no):
+                continue
+            self._inc(f"faults_injected_{rule.action}")
+            if rule.action == "delay":
+                time.sleep(rule.ms / 1000.0)  # analysis: ok(host-only ms->s)
+            elif rule.action == "corrupt_ckpt" and checkpoint is not None:
+                if checkpoint.chaos_corrupt():
+                    self._inc("faults_ckpt_corrupted")
+
+    def counts(self):
+        with self._lock:
+            return {f"{r.action}@{r.tag}": {"seen": r.seen, "fired": r.fired}
+                    for r in self.rules}

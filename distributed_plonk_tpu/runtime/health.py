@@ -1,0 +1,166 @@
+"""Fleet liveness tracking: per-worker circuit breaker + probe backoff.
+
+The dispatcher-side health model the reference never had (every worker RPC
+there is an `.unwrap()`, /root/reference/src/worker.rs:303 — one crash
+panics the prove). Here each worker carries a tiny state machine:
+
+    CLOSED   healthy: requests route to it normally.
+    OPEN     dead: `breaker_k` CONSECUTIVE call failures opened the
+             breaker; requests fast-fail (`usable()` is False) so callers
+             adopt its ranges instead of burning reconnect timeouts.
+    half-open (implicit): once `next_probe` passes, exactly ONE caller per
+             window gets `probe_due()` True and sends a cheap HEALTH/PING
+             on a fresh connection; success re-admits (CLOSED), failure
+             pushes `next_probe` out exponentially (with jitter).
+
+All mutable state lives in per-worker dicts guarded by `self._lock`
+(LOCK01/02 discipline — analysis/lint.py runs over runtime/ too). The
+tracker never talks to the network itself: callers report outcomes via
+`record_ok`/`record_failure` and run the probes it schedules, so it stays
+backend- and transport-agnostic (and trivially testable).
+
+Knobs (env, read at construction):
+    DPT_BREAKER_K        consecutive failures to open the breaker (3)
+    DPT_PROBE_BASE_MS    first re-admission probe delay (200)
+    DPT_PROBE_MAX_MS     probe backoff ceiling (5000)
+"""
+
+import os
+import random
+import threading
+import time
+
+
+class NullMetrics:
+    """No-op stand-in for the duck-typed service.metrics.Metrics shape —
+    the one shared null object for every layer that takes an optional
+    registry (tracker, dispatcher, artifact store)."""
+
+    def inc(self, name, by=1):
+        pass
+
+    def gauge(self, name, value):
+        pass
+
+    def observe(self, name, seconds):
+        pass
+
+
+class LivenessTracker:
+    """Per-worker consecutive-failure circuit breaker with probe backoff."""
+
+    def __init__(self, n_workers, breaker_k=None, probe_base_s=None,
+                 probe_max_s=None, metrics=None, rng=None):
+        self.breaker_k = breaker_k if breaker_k is not None else int(
+            os.environ.get("DPT_BREAKER_K", "3"))
+        self.probe_base_s = probe_base_s if probe_base_s is not None else \
+            float(os.environ.get("DPT_PROBE_BASE_MS", "200")) / 1000.0  # analysis: ok(host-only ms->s)
+        self.probe_max_s = probe_max_s if probe_max_s is not None else \
+            float(os.environ.get("DPT_PROBE_MAX_MS", "5000")) / 1000.0  # analysis: ok(host-only ms->s)
+        self.metrics = metrics or NullMetrics()
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._state = [self._fresh() for _ in range(n_workers)]
+
+    @staticmethod
+    def _fresh():
+        return {"open": False, "failures": 0, "next_probe": 0.0,
+                "probe_backoff": 0.0, "opens": 0}
+
+    def _jitter(self, base):
+        """base + up to 50% random jitter: fleet-wide probes/retries must
+        not synchronize into thundering herds."""
+        return base * (1.0 + 0.5 * self._rng.random())  # analysis: ok(host-only jitter)
+
+    # -- outcome reporting ----------------------------------------------------
+
+    def record_ok(self, i):
+        """A successful call: reset failures; re-admit if OPEN (the call
+        doubled as a successful probe)."""
+        with self._lock:
+            s = self._state[i]
+            readmitted = s["open"]
+            s["open"] = False
+            s["failures"] = 0
+            s["probe_backoff"] = 0.0
+        if readmitted:
+            self.metrics.inc("fleet_readmissions")
+        return readmitted
+
+    def record_failure(self, i):
+        """A failed call (reconnect retries exhausted). Returns True when
+        this failure OPENED the breaker."""
+        now = time.monotonic()
+        with self._lock:
+            s = self._state[i]
+            s["failures"] += 1
+            opened = not s["open"] and s["failures"] >= self.breaker_k
+            if opened:
+                s["open"] = True
+                s["opens"] += 1
+            if s["open"]:
+                # failure while open (probe failed): back off the next probe
+                s["probe_backoff"] = min(
+                    self.probe_max_s,
+                    (s["probe_backoff"] * 2) or self.probe_base_s)
+                s["next_probe"] = now + self._jitter(s["probe_backoff"])
+        if opened:
+            self.metrics.inc("fleet_breaker_opens")
+        return opened
+
+    def mark_dead(self, i):
+        """Authoritative death report (a direct probe just failed): open
+        the breaker immediately, regardless of the consecutive count."""
+        now = time.monotonic()
+        with self._lock:
+            s = self._state[i]
+            opened = not s["open"]
+            s["open"] = True
+            s["failures"] = max(s["failures"], self.breaker_k)
+            if opened:
+                s["opens"] += 1
+                s["probe_backoff"] = self.probe_base_s
+                s["next_probe"] = now + self._jitter(s["probe_backoff"])
+        if opened:
+            self.metrics.inc("fleet_breaker_opens")
+        return opened
+
+    # -- routing decisions ----------------------------------------------------
+
+    def usable(self, i):
+        with self._lock:
+            return not self._state[i]["open"]
+
+    def usable_set(self):
+        with self._lock:
+            return [i for i, s in enumerate(self._state) if not s["open"]]
+
+    def probe_due(self, i):
+        """True at most once per probe window: the caller that gets True
+        owns the half-open probe; the window is immediately pushed out —
+        by the CURRENT backoff, since record_failure owns the exponential
+        advance (granting must not double, or a failed probe cycle
+        advances x4) — so concurrent callers don't dogpile a
+        maybe-recovering worker."""
+        now = time.monotonic()
+        with self._lock:
+            s = self._state[i]
+            if not s["open"] or now < s["next_probe"]:
+                return False
+            s["next_probe"] = now + self._jitter(
+                s["probe_backoff"] or self.probe_base_s)
+            return True
+
+    def due_probes(self):
+        return [i for i in range(len(self._state)) if self.probe_due(i)]
+
+    def force_probe(self, i=None):
+        """Make the next probe_due() True immediately (tests, an operator
+        'I restarted it, re-admit now' path)."""
+        with self._lock:
+            for s in (self._state if i is None else [self._state[i]]):
+                s["next_probe"] = 0.0
+
+    def snapshot(self):
+        with self._lock:
+            return [dict(s) for s in self._state]

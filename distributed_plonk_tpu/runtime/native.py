@@ -26,7 +26,10 @@ def build_native():
     so_path = os.path.join(_BUILD_DIR, f"dpt_native_{digest}.so")
     if not os.path.exists(so_path):
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        tmp = so_path + ".tmp"
+        # pid-unique tmp + atomic rename: a fleet of worker subprocesses
+        # all hitting a fresh source hash build concurrently; a SHARED
+        # tmp path lets one racer rename the file out from under another
+        tmp = f"{so_path}.tmp.{os.getpid()}"
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
             check=True)
@@ -47,7 +50,8 @@ def lib():
         L.transpose_u32.argtypes = [u32p, ctypes.c_uint64, ctypes.c_uint64, u32p]
         L.dpt_listen.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         L.dpt_accept.argtypes = [ctypes.c_int]
-        L.dpt_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        L.dpt_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_int]
         L.dpt_send.argtypes = [ctypes.c_int, ctypes.c_uint32, u8p, ctypes.c_uint64]
         L.dpt_recv_header.argtypes = [
             ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
@@ -153,8 +157,10 @@ class Listener:
             self.fd = -1
 
 
-def connect(host, port):
-    fd = lib().dpt_connect(host.encode(), port)
+def connect(host, port, timeout_ms=0):
+    """timeout_ms bounds the CONNECT itself (0 = blocking); I/O timeouts
+    are set separately via Conn.set_timeout after the dial succeeds."""
+    fd = lib().dpt_connect(host.encode(), port, timeout_ms)
     if fd < 0:
         raise ConnectionError(f"cannot connect to {host}:{port}")
     return Conn(fd)

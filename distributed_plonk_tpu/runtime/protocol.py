@@ -38,6 +38,9 @@ FFT_EXCHANGE = 9   # worker->worker: u64 id, u64 col_start, u64 col_count,
                    # (row_count x col_count) panel of 32B scalars -> OK
 FFT2 = 10          # u64 id -> reply (ce-cs)*c_len*32B stage-2 rows + task GC
 STATS = 11         # -> reply JSON {tag: count} served-request counters
+HEALTH = 12        # -> reply JSON {uptime_s, served, fft_tasks, base_sets}:
+                   # the liveness/re-admission probe (runtime/health.py) —
+                   # cheaper than STATS to interpret, richer than PING
 # --- proof service control plane (service/server.py) -------------------------
 # Rides the exact same framed transport; payloads are JSON (control plane is
 # cold — the hot data plane above keeps its binary codecs).
@@ -56,6 +59,13 @@ WARMUP = 25        # JSON job spec (+ optional "aot": true) -> OK + JSON
                    # warm_s, aot?}: pre-resolve a shape bucket's keys
                    # through the store tiers and (aot) precompile its
                    # prover stages, so later SUBMITs of the shape are warm
+STORE_FETCH = 26   # JSON {key} -> OK + [u32 hdr][hdr JSON {key, digest,
+                   # meta}][blob]: serve one artifact-store blob (bucket
+                   # keys, prover checkpoint, SRS) to a peer/replacement
+                   # host — cross-host warm start and resume become a
+                   # network copy instead of a rebuild (store/remote.py
+                   # re-verifies the digest client-side). Served by the
+                   # proof service and by runtime workers given --store.
 OK = 100
 ERR = 101
 

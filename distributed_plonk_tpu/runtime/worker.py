@@ -18,7 +18,11 @@ arrive on the same port, distinguished by tag (netconfig.py documents the
 single-plane choice).
 
 Run: python -m distributed_plonk_tpu.runtime.worker <index> [config.json]
-    [--backend python|jax]
+    [--backend python|jax] [--store DIR]
+
+--store serves the given artifact store over the STORE_FETCH tag (a
+replacement worker on a fresh host pulls SRS/pk/checkpoint blobs from a
+peer instead of rebuilding — store/remote.py is the client side).
 """
 
 import os
@@ -80,10 +84,12 @@ class FftTask:
 
 
 class WorkerState:
-    def __init__(self, backend, config=None, me=0):
+    def __init__(self, backend, config=None, me=0, store=None):
         self.backend = backend
         self.config = config
         self.me = me
+        self.store = store  # optional ArtifactStore served via STORE_FETCH
+        self.started = time.monotonic()
         self.base_sets = {}  # set_id -> bases (a worker can adopt ranges)
         self.lock = threading.Lock()
         self.domains = {}
@@ -118,6 +124,35 @@ class WorkerState:
                 conn = native.connect(host, port)
                 self.peers[p] = (conn, threading.Lock())
             return self.peers[p]
+
+    def drop_peer(self, p):
+        """Forget a cached peer connection (it broke mid-exchange — the
+        peer died or restarted; the next peer() dials fresh)."""
+        with self.peer_lock:
+            entry = self.peers.pop(p, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:  # pragma: no cover - already dead
+                pass
+
+    def peer_call(self, p, tag, payload):
+        """One request/reply to peer p, retrying ONCE on a fresh
+        connection: a cached stream goes stale when the peer restarts
+        (cross-host re-admission), and the exchange payload is idempotent
+        at the receiver (region-mask overwrite), so a blind resend is
+        safe. Raises on the second failure — the dispatcher's fleet probe
+        then attributes the death correctly."""
+        for attempt in (0, 1):
+            pconn, plock = self.peer(p)
+            with plock:
+                try:
+                    pconn.send(tag, payload)
+                    return pconn.recv()
+                except (ConnectionError, OSError):
+                    self.drop_peer(p)
+                    if attempt:
+                        raise
 
 
 def _stage1_row(backend, domain_r, task, j2, row):
@@ -188,6 +223,35 @@ def handle(conn, state):
 # reply) are purged much sooner; both checked on every FFT_INIT
 _FFT_TASK_TTL_S = float(os.environ.get("DPT_FFT_TASK_TTL", "600"))
 _FFT_DONE_TTL_S = float(os.environ.get("DPT_FFT_DONE_TTL", "60"))
+# hard cap on resident tasks (the FFT2 replay cache grew per task_id with
+# no bound between FFT_INITs — a fast dispatcher loop could OOM a worker
+# inside one TTL window): LRU eviction, completed tasks first (their reply
+# cache is the cheap thing to lose — a retry after eviction recomputes),
+# then oldest in-flight (those are abandoned replans by construction when
+# the cap is hit)
+_FFT_TASK_CAP = int(os.environ.get("DPT_FFT_TASK_CAP", "64"))
+
+
+def _evict_fft_tasks(tasks, cap, now):
+    """TTL purge + LRU cap for the task table (state.lock held). Keeps at
+    most `cap` - 1 entries so the task the caller is about to insert fits."""
+    stale = [tid for tid, t in tasks.items()
+             if (now - t.created > _FFT_TASK_TTL_S
+                 or (t.done_at is not None
+                     and now - t.done_at > _FFT_DONE_TTL_S))]
+    for tid in stale:
+        del tasks[tid]
+    room = max(cap - 1, 0)
+    if len(tasks) <= room:
+        return
+    done = sorted((tid for tid, t in tasks.items() if t.done_at is not None),
+                  key=lambda tid: tasks[tid].done_at)
+    live = sorted((tid for tid, t in tasks.items() if t.done_at is None),
+                  key=lambda tid: tasks[tid].created)
+    for tid in done + live:
+        if len(tasks) <= room:
+            break
+        del tasks[tid]
 
 
 def _dispatch(conn, state, tag, payload):
@@ -234,12 +298,7 @@ def _dispatch(conn, state, tag, payload):
          col_ranges) = protocol.decode_fft_init(payload)
         now = time.monotonic()
         with state.lock:
-            stale = [tid for tid, t in state.fft_tasks.items()
-                     if (now - t.created > _FFT_TASK_TTL_S
-                         or (t.done_at is not None
-                             and now - t.done_at > _FFT_DONE_TTL_S))]
-            for tid in stale:
-                del state.fft_tasks[tid]
+            _evict_fft_tasks(state.fft_tasks, _FFT_TASK_CAP, now)
             state.fft_tasks[task_id] = FftTask(
                 inverse, coset, n, r, c, rs, re, col_ranges, state.me)
         conn.send(protocol.OK)
@@ -295,12 +354,12 @@ def _dispatch(conn, state, tag, payload):
                 if pe == ps:
                     continue
                 panel = np.ascontiguousarray(rows_np[:, :, ps:pe])
-                pconn, plock = state.peer(p)
-                with plock:
-                    pconn.send(protocol.FFT_EXCHANGE,
-                               protocol.encode_fft_exchange(
-                                   task_id, ps, pe - ps, task.rs, panel))
-                    rtag, rpayload = pconn.recv()
+                # peer_call retries once on a fresh stream: a peer that
+                # restarted since the last FFT invalidates the cached conn
+                rtag, rpayload = state.peer_call(
+                    p, protocol.FFT_EXCHANGE,
+                    protocol.encode_fft_exchange(
+                        task_id, ps, pe - ps, task.rs, panel))
                 if rtag != protocol.OK:
                     raise RuntimeError(f"peer {p} exchange failed: {rpayload!r}")
         conn.send(protocol.OK)
@@ -346,6 +405,27 @@ def _dispatch(conn, state, tag, payload):
         with state.lock:
             snap = dict(state.counters)
         conn.send(protocol.OK, _json.dumps(snap).encode())
+    elif tag == protocol.HEALTH:
+        # the liveness/re-admission probe (runtime/health.py): cheap,
+        # lock-scoped snapshot — MUST stay fast even mid-FFT, a probe
+        # that queues behind a kernel defeats the breaker's fast-fail
+        import json as _json
+        with state.lock:
+            snap = {
+                "uptime_s": round(time.monotonic() - state.started, 3),
+                "served": sum(state.counters.values()),
+                "fft_tasks": len(state.fft_tasks),
+                "base_sets": sorted(state.base_sets),
+                "backend": getattr(state.backend, "name", "?"),
+            }
+        conn.send(protocol.OK, _json.dumps(snap).encode())
+    elif tag == protocol.STORE_FETCH:
+        # peer-serving plane: a replacement worker on a fresh host pulls
+        # SRS/pk/checkpoint blobs from us instead of rebuilding them
+        from ..store import remote as store_remote
+        store_remote.serve_fetch(
+            state.store, payload, conn,
+            no_store_reason="no store on this worker (--store)")
     elif tag == protocol.SHUTDOWN:
         conn.send(protocol.OK)
         return False
@@ -354,10 +434,16 @@ def _dispatch(conn, state, tag, payload):
     return None
 
 
-def serve(index, config, backend_name="python", ready_event=None):
+def serve(index, config, backend_name="python", ready_event=None,
+          store_dir=None):
     host, port = config.workers[index]
     listener = native.Listener(host, port)
-    state = WorkerState(_make_backend(backend_name), config=config, me=index)
+    store = None
+    if store_dir is not None:
+        from ..store import ArtifactStore
+        store = ArtifactStore(store_dir)
+    state = WorkerState(_make_backend(backend_name), config=config, me=index,
+                        store=store)
     if ready_event is not None:
         ready_event.set()
     stop = threading.Event()
@@ -385,7 +471,10 @@ def main(argv):
     backend = "python"
     if "--backend" in argv:
         backend = argv[argv.index("--backend") + 1]
-    serve(index, NetworkConfig.load(cfg_path), backend)
+    store_dir = None
+    if "--store" in argv:
+        store_dir = argv[argv.index("--store") + 1]
+    serve(index, NetworkConfig.load(cfg_path), backend, store_dir=store_dir)
 
 
 if __name__ == "__main__":

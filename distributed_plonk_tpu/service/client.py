@@ -73,6 +73,15 @@ class ServiceClient:
     def metrics(self):
         return protocol.decode_json(self._call(protocol.METRICS))
 
+    def store_fetch(self, key):
+        """-> (header dict {key, digest, meta}, blob bytes) for one
+        artifact-store entry on the server. Raises ServiceError on a
+        miss. store.remote.fetch_into is the digest-verifying consumer;
+        this raw accessor is for tooling/tests."""
+        return protocol.decode_result(
+            self._call(protocol.STORE_FETCH,
+                       protocol.encode_json({"key": key})))
+
     def kill_worker(self, worker=None, job_id=None, at_round=None):
         req = {}
         if worker is not None:

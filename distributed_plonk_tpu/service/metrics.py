@@ -9,6 +9,21 @@ one JSON-able dict for the METRICS wire tag.
 Histograms keep a bounded reservoir (uniform sampling past the cap, so
 long runs stay O(1) memory) and report count/sum/min/mean/percentiles
 computed from the reservoir at snapshot time.
+
+Failure-observability vocabulary (one registry can be handed to the
+runtime Dispatcher AND the service pool, so a whole deployment's fault
+story reads off one snapshot):
+    fleet_reconnects / fleet_backoff_waits   reconnect loop activity
+    fleet_backoff (histogram)                seconds slept in backoff
+    fleet_breaker_opens / fleet_readmissions circuit-breaker transitions
+    fleet_range_adoptions                    MSM ranges moved off a dead
+                                             worker (runtime dispatcher)
+    fleet_fft_replans / fleet_fft_degraded   sharded-FFT recovery events
+    checkpoint_saves / checkpoint_resumes    prover round snapshots and
+                                             resumed (not restarted)
+                                             attempts (service pool)
+    faults_injected_* / faults_ckpt_corrupted  chaos-injection activity
+                                             (runtime/faults.py)
 """
 
 import random

@@ -28,7 +28,7 @@ import threading
 import time
 import queue as _stdlib_queue
 
-from ..checkpoint import ProverCheckpoint
+from ..checkpoint import ProverCheckpoint, StoreCheckpoint
 from ..prover import prove
 from ..proof_io import serialize_proof
 from ..trace import Tracer
@@ -48,22 +48,51 @@ def _default_backend():
     return PythonBackend()
 
 
-class _GuardedCheckpoint(ProverCheckpoint):
-    """ProverCheckpoint that gives the pool a round-boundary control point:
-    kill flags and deadlines fire here, AFTER the round's snapshot is
-    durable, so the subsequent retry has the maximum state to resume from."""
+class _GuardHooks:
+    """Round-boundary control points the pool mixes into a checkpoint
+    backend: kill flags and deadlines fire AFTER the round's snapshot is
+    durable (so the subsequent retry has the maximum state to resume
+    from), the fault injector's checkpoint plane (slow-prover delay,
+    snapshot corruption) runs at the same boundary, and resumes/saves
+    land in the metrics registry."""
 
-    def __init__(self, path, worker):
-        super().__init__(path)
+    def _arm_guard(self, worker, metrics=None, faults=None):
         self.worker = worker
+        self._metrics = metrics
+        self._faults = faults
+        return self
 
     def load(self, fingerprint):
         self.worker.check(round_no=0)
-        return super().load(fingerprint)
+        state = super().load(fingerprint)
+        if state is not None and self._metrics is not None:
+            # a non-None load means this attempt RESUMES mid-prove
+            # (cross-host or same-host) instead of restarting at round 1
+            self._metrics.inc("checkpoint_resumes")
+        return state
 
     def save(self, round_no, *args, **kwargs):
         super().save(round_no, *args, **kwargs)
+        if self._metrics is not None:
+            self._metrics.inc("checkpoint_saves")
+        if self._faults is not None:
+            self._faults.on_round(round_no, checkpoint=self)
         self.worker.check(round_no=round_no)
+
+
+class _GuardedCheckpoint(_GuardHooks, ProverCheckpoint):
+    def __init__(self, path, worker, metrics=None, faults=None):
+        super().__init__(path)
+        self._arm_guard(worker, metrics, faults)
+
+
+class _GuardedStoreCheckpoint(_GuardHooks, StoreCheckpoint):
+    """Store-backed variant: snapshots are content-addressed artifacts
+    (SHA-verified, budget-shared, STORE_FETCHable by a replacement host)."""
+
+    def __init__(self, store, name, worker, metrics=None, faults=None):
+        super().__init__(store, name)
+        self._arm_guard(worker, metrics, faults)
 
 
 class _Worker:
@@ -95,10 +124,16 @@ _STOP = object()
 class WorkerPool:
     def __init__(self, metrics, prover_workers=2, max_retries=2,
                  job_timeout_s=None, ckpt_dir=None, backend_factory=None,
-                 verify_on_complete=False):
+                 verify_on_complete=False, store=None, faults=None):
         self.metrics = metrics
         self.max_retries = max_retries
         self.job_timeout_s = job_timeout_s
+        # checkpoint surface: with a store, snapshots are content-addressed
+        # store artifacts (one durability surface + one eviction policy,
+        # and a replacement host can STORE_FETCH them); the ckpt-dir file
+        # path remains the storeless fallback
+        self.store = store
+        self.faults = faults
         self.ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="dpt-service-ck-")
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self.backend_factory = backend_factory or _default_backend
@@ -180,6 +215,23 @@ class WorkerPool:
     def _ckpt_path(self, job):
         return os.path.join(self.ckpt_dir, f"{job.id}.ckpt.npz")
 
+    def _make_guard(self, job, worker):
+        if self.store is not None:
+            return _GuardedStoreCheckpoint(self.store, job.id, worker,
+                                           metrics=self.metrics,
+                                           faults=self.faults)
+        return _GuardedCheckpoint(self._ckpt_path(job), worker,
+                                  metrics=self.metrics, faults=self.faults)
+
+    def _clear_ckpt(self, job):
+        if self.store is not None:
+            StoreCheckpoint(self.store, job.id).clear()
+            return
+        try:
+            os.remove(self._ckpt_path(job))
+        except OSError:
+            pass
+
     def _loop(self, worker):
         backend = self.backend_factory()
         while True:
@@ -245,10 +297,7 @@ class WorkerPool:
 
     def _fail(self, job, reason):
         self.metrics.inc("jobs_failed")
-        try:
-            os.remove(self._ckpt_path(job))
-        except OSError:
-            pass
+        self._clear_ckpt(job)
         job.finish_err(reason)
 
     def _run_attempt(self, worker, backend, job, res):
@@ -257,7 +306,7 @@ class WorkerPool:
         try:
             tracer = Tracer()
             ckt = J.build_circuit(job.spec)
-            guard = _GuardedCheckpoint(self._ckpt_path(job), worker)
+            guard = self._make_guard(job, worker)
             try:
                 proof = prove(random.Random(job.spec.seed), ckt, res.pk,
                               backend, tracer=tracer, checkpoint=guard)

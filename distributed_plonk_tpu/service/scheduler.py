@@ -24,6 +24,7 @@ keeps scheduling from racing ahead of proving capacity.
 """
 
 import itertools
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -62,10 +63,17 @@ class BucketCache:
     counters/gauges.
     """
 
-    def __init__(self, metrics, backend=None, store=None, max_entries=None):
+    def __init__(self, metrics, backend=None, store=None, max_entries=None,
+                 peers=None):
         self.metrics = metrics
         self.backend = backend
         self.store = store
+        # peers: [(host, port)] speaking STORE_FETCH — tier 2.5, between
+        # local disk and full build: a fresh host pulls a warm peer's key
+        # blob (digest-verified network copy) instead of re-running
+        # trusted setup + preprocess (ROADMAP: store-backed distributed
+        # serving; cold start for a scaled-out replica = one fetch)
+        self.peers = list(peers or [])
         self.max_entries = max_entries
         self._lock = threading.Lock()
         self._buckets = OrderedDict()
@@ -99,6 +107,8 @@ class BucketCache:
         if self.store is not None:
             t0 = time.monotonic()
             hit = KC.load_bucket(self.store, key)
+            if hit is None and self.peers:
+                hit = self._fetch_from_peers(key)
             if hit is not None:
                 srs, pk, vk, meta = hit
                 self.metrics.inc("bucket_disk_hits")
@@ -121,6 +131,32 @@ class BucketCache:
             except Exception:  # pragma: no cover - environmental
                 self.metrics.inc("store_write_errors")
         return res, "built"
+
+    # per-peer dial+transfer budget for the fetch tier. Peer fetch runs
+    # under the cache lock (build dedup), so an unreachable peer stalls
+    # OTHER shapes' lookups for this long per peer per cold miss — keep
+    # it far below fetch_into's 30 s default. (Moving the fetch/build
+    # outside the lock behind a per-key latch is the structural fix,
+    # tracked in ROADMAP direction 2.)
+    PEER_TIMEOUT_MS = int(os.environ.get("DPT_PEER_FETCH_TIMEOUT_MS", "5000"))
+
+    def _fetch_from_peers(self, key):
+        """Try each peer's STORE_FETCH for this bucket's key blob; a hit
+        lands in the local store (so the fetch pays once) and parses
+        through the normal disk-tier loader. Any per-peer failure falls
+        through — the build tier is always below us."""
+        from ..store import remote as RS
+        store_key = KC.bucket_store_key(key)
+        for host, port in self.peers:
+            blob = RS.fetch_into(self.store, host, port, store_key,
+                                 timeout_ms=self.PEER_TIMEOUT_MS)
+            if blob is None:
+                continue
+            hit = KC.load_bucket(self.store, key)
+            if hit is not None:
+                self.metrics.inc("bucket_peer_hits")
+                return hit
+        return None
 
 
 class Scheduler:

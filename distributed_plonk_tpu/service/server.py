@@ -16,7 +16,7 @@ import threading
 import time
 
 from ..runtime import native, protocol
-from ..store import ArtifactStore, aot_warmup
+from ..store import ArtifactStore, aot_warmup, remote
 from .jobs import Job, JobSpec
 from .metrics import Metrics
 from .pool import WorkerPool
@@ -30,18 +30,14 @@ class ProofService:
                  job_timeout_s=None, ckpt_dir=None, chaos=False,
                  backend_factory=None, verify_on_complete=False,
                  finished_retention=4096, allow_remote_shutdown=False,
-                 store_dir=None, store_byte_budget=None, bucket_cap=64):
+                 store_dir=None, store_byte_budget=None, bucket_cap=64,
+                 store_peers=None, faults=None):
         self.host = host
         self.port = port
         self.chaos = chaos
         self.allow_remote_shutdown = allow_remote_shutdown
         self.metrics = Metrics()
         self.queue = JobQueue(max_depth=queue_depth)
-        self.pool = WorkerPool(
-            self.metrics, prover_workers=prover_workers,
-            max_retries=max_retries, job_timeout_s=job_timeout_s,
-            ckpt_dir=ckpt_dir, backend_factory=backend_factory,
-            verify_on_complete=verify_on_complete)
         self.store = None
         if store_dir is not None:
             # NOTE: the service does not repoint the JAX compile cache —
@@ -53,8 +49,27 @@ class ProofService:
             self.store = ArtifactStore(store_dir,
                                        byte_budget=store_byte_budget,
                                        metrics=self.metrics.scoped("store"))
+        # faults: runtime.faults.FaultInjector (chaos mode only) — the
+        # pool runs its checkpoint-plane rules at round boundaries. An
+        # injector built without a metrics registry adopts ours, so its
+        # faults_injected_*/faults_ckpt_corrupted counters show up in the
+        # same METRICS snapshot as the recovery counters they provoke.
+        self.faults = faults if chaos else None
+        if self.faults is not None and self.faults.metrics is None:
+            self.faults.metrics = self.metrics
+        self.pool = WorkerPool(
+            self.metrics, prover_workers=prover_workers,
+            max_retries=max_retries, job_timeout_s=job_timeout_s,
+            ckpt_dir=ckpt_dir, backend_factory=backend_factory,
+            verify_on_complete=verify_on_complete, store=self.store,
+            faults=self.faults)
+        # store_peers: [(host, port)] of peers speaking STORE_FETCH — a
+        # bucket miss tries a network copy from a warm peer before paying
+        # for a full key build (elastic scale-out: a fresh host serves
+        # warm after one fetch)
         self.buckets = BucketCache(self.metrics, store=self.store,
-                                   max_entries=bucket_cap)
+                                   max_entries=bucket_cap,
+                                   peers=store_peers)
         self.scheduler = Scheduler(self.queue, self.pool, self.metrics,
                                    buckets=self.buckets, max_batch=max_batch)
         self._warm_backend = None
@@ -244,6 +259,14 @@ class ProofService:
                     {"reason": f"bad_spec: {e}"}))
                 return None
             conn.send(protocol.OK, protocol.encode_json(out))
+        elif tag == protocol.STORE_FETCH:
+            # serve one artifact blob to a peer/replacement host: bucket
+            # keys, prover checkpoints, anything under the store —
+            # cross-host warm start and resume become a digest-verified
+            # network copy (store/remote.py holds both wire sides)
+            remote.serve_fetch(
+                self.store, payload, conn, metrics=self.metrics,
+                no_store_reason="no store on this server (serve --store-dir)")
         elif tag == protocol.METRICS:
             snap = self.metrics.snapshot()
             snap["gauges"]["queue_depth"] = self.queue.depth()

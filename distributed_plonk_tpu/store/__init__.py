@@ -22,9 +22,11 @@ from .keycache import (bucket_store_key, serialize_bucket,
                        deserialize_bucket, store_bucket, load_bucket)
 from .warmstart import (set_jax_cache_env, configure_jax_cache,
                         aot_warmup, warm_spec)
+from .remote import FetchError, fetch_blob, fetch_into
 
 __all__ = [
     "ArtifactStore", "bucket_store_key", "serialize_bucket",
     "deserialize_bucket", "store_bucket", "load_bucket",
     "set_jax_cache_env", "configure_jax_cache", "aot_warmup", "warm_spec",
+    "FetchError", "fetch_blob", "fetch_into",
 ]

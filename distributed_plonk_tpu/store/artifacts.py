@@ -51,6 +51,8 @@ import os
 import threading
 import time
 
+from ..runtime.health import NullMetrics
+
 try:
     import fcntl
 except ImportError:  # pragma: no cover - non-posix fallback
@@ -89,19 +91,13 @@ class _FileLock:
         return False
 
 
-class _NullMetrics:
-    def inc(self, name, by=1):
-        pass
-
-    def gauge(self, name, value):
-        pass
 
 
 class ArtifactStore:
     def __init__(self, root, byte_budget=None, metrics=None):
         self.root = root
         self.byte_budget = byte_budget
-        self.metrics = metrics or _NullMetrics()
+        self.metrics = metrics or NullMetrics()
         self._lock = threading.Lock()
         os.makedirs(os.path.join(root, "objects"), exist_ok=True)
         self._manifest_path = os.path.join(root, "manifest.json")
@@ -274,6 +270,14 @@ class ArtifactStore:
         """Blob for `key`, or None (miss, or integrity failure — in which
         case the corrupt entry is deleted so the caller's rebuild can
         repopulate it)."""
+        hit = self.get_entry(key)
+        return hit[0] if hit is not None else None
+
+    def get_entry(self, key):
+        """-> (blob, digest, meta) for a verified hit, or None. The digest
+        is the one the read was just verified against, so STORE_FETCH
+        servers (store/remote.serve_fetch) can advertise it without
+        hashing the blob a second time."""
         with self._lock:
             e = self._manifest["entries"].get(key)
             if e is None:
@@ -317,7 +321,7 @@ class ArtifactStore:
             # manifest). Recency is persisted by the next real write
             # (put/delete), which is also when eviction reads it.
             e["seq"] = self._next_seq()
-            return blob
+            return blob, e["digest"], dict(e["meta"])
 
     def delete(self, key):
         with self._lock:
@@ -340,11 +344,16 @@ class ArtifactStore:
             log.warning("store %s: %s unreadable (%s); dropping entry",
                         self.root, key, err)
             return None
-        if len(blob) != e["bytes"] or \
-                hashlib.sha256(blob).hexdigest() != e["digest"]:
+        if len(blob) != e["bytes"]:
             log.warning("store %s: %s failed integrity check "
                         "(%d bytes on disk, %d expected); dropping entry",
                         self.root, key, len(blob), e["bytes"])
+            return None
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != e["digest"]:
+            log.warning("store %s: %s failed integrity check "
+                        "(digest %s.. != %s..); dropping entry",
+                        self.root, key, digest[:12], e["digest"][:12])
             return None
         return blob
 

@@ -21,8 +21,10 @@
 #include <cerrno>
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -133,7 +135,11 @@ int dpt_accept(int listen_fd) {
     return fd;
 }
 
-int dpt_connect(const char* host, int port) {
+// timeout_ms <= 0: blocking connect (OS default, ~2 min on a dropped
+// SYN). > 0: non-blocking connect + poll, so a partitioned/firewalled
+// peer costs a bounded wait instead of stalling the caller (the store
+// peer-fetch tier runs under the scheduler's bucket lock).
+int dpt_connect(const char* host, int port, int timeout_ms) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     sockaddr_in addr;
@@ -141,7 +147,41 @@ int dpt_connect(const char* host, int port) {
     addr.sin_family = AF_INET;
     addr.sin_port = htons((uint16_t)port);
     if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) { close(fd); return -1; }
-    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { close(fd); return -1; }
+    if (timeout_ms <= 0) {
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) { close(fd); return -1; }
+    } else {
+        int flags = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+        if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            if (errno != EINPROGRESS) { close(fd); return -1; }
+            pollfd p;
+            p.fd = fd;
+            p.events = POLLOUT;
+            // retry on EINTR with the remaining budget: an interrupted
+            // dial is not an unreachable peer (a spurious -1 here would
+            // feed probe() a false death report)
+            int remaining = timeout_ms;
+            struct timeval tv0;
+            gettimeofday(&tv0, nullptr);
+            int rc;
+            for (;;) {
+                rc = poll(&p, 1, remaining);
+                if (rc >= 0 || errno != EINTR) break;
+                struct timeval tv1;
+                gettimeofday(&tv1, nullptr);
+                int elapsed = (int)((tv1.tv_sec - tv0.tv_sec) * 1000 +
+                                    (tv1.tv_usec - tv0.tv_usec) / 1000);
+                remaining = timeout_ms - elapsed;
+                if (remaining <= 0) { rc = 0; break; }
+            }
+            if (rc <= 0) { close(fd); return -1; }
+            int err = 0;
+            socklen_t elen = sizeof(err);
+            if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 ||
+                err != 0) { close(fd); return -1; }
+        }
+        fcntl(fd, F_SETFL, flags);
+    }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     return fd;

@@ -14,9 +14,19 @@
 #                          the FULL kernel registry + carry contracts + repo
 #                          lints (python -m distributed_plonk_tpu.analysis,
 #                          ~90 s of pure tracing, nothing executes)
+#   scripts/ci.sh chaos    fault-domain suite: dead-worker sweep over every
+#                          protocol phase (byte-identical proofs), breaker
+#                          open/re-admission, cross-host store-fetch resume,
+#                          injection layer (~1-2 min, jax-free: python
+#                          backend worker subprocesses over real TCP)
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
+fi
+if [ "$1" = "chaos" ]; then
+  exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_runtime_faults.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "fast" ]; then
   # the AST lints cost <1 s and catch the jit-cache/promotion/lock bug
@@ -24,6 +34,9 @@ if [ "$1" = "fast" ]; then
   # the full registry is ~90 s)
   env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis \
     --only lint --strict -q || exit 1
+  # the chaos subset rides along: it is jax-free (no compiles) and pins
+  # the fault-domain acceptance surface before kernel-parity compiles start
+  bash scripts/ci.sh chaos || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ntt_jax.py tests/test_curve_msm_jax.py \
     tests/test_msm_update_paths.py tests/test_msm_pallas.py \

@@ -4,6 +4,8 @@
     JAX_PLATFORMS=cpu python scripts/loadgen.py            # self-hosted run
     python scripts/loadgen.py --host 127.0.0.1 --port 9555 # external server
     python scripts/loadgen.py --jobs 12 --no-kill
+    python scripts/loadgen.py --kill-rate 0.5 --corrupt-rate 0.3 \
+        --delay-ms 5 --store-dir /tmp/s                    # chaos soak
 
 Default run: spins up an in-process ProofService (chaos mode, host oracle
 backend), then N submitter threads (default 8, mixed toy domain sizes
@@ -65,24 +67,76 @@ def main():
     ap.add_argument("--no-kill", action="store_true")
     ap.add_argument("--kill-attempts", type=int, default=3,
                     help="re-tries if the kill races a finishing prove")
+    ap.add_argument("--kill-rate", type=float, default=0.0,
+                    help="chaos: probability per regular job of killing "
+                         "its worker mid-prove (KILL_WORKER) — every "
+                         "proof must STILL verify")
+    ap.add_argument("--corrupt-rate", type=float, default=0.0,
+                    help="chaos (self-hosted only): probability per round "
+                         "boundary of flipping a byte in the just-saved "
+                         "checkpoint artifact; the store's SHA-256 must "
+                         "catch it and the retry restart cleanly")
+    ap.add_argument("--delay-ms", type=float, default=0.0,
+                    help="chaos (self-hosted only): slow-prover delay "
+                         "injected at every round boundary")
+    ap.add_argument("--chaos-seed", type=int, default=0xC4A05,
+                    help="seed for rate-based chaos decisions")
     ap.add_argument("--timeout", type=float, default=600)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
     from distributed_plonk_tpu.service import ProofService, ServiceClient
 
+    chaos_rng = random.Random(args.chaos_seed)
     svc = None
     host = args.host
     port = args.port
     if host is None:
+        # round-boundary chaos rides the new injection layer
+        # (runtime/faults.py); wire-level kills keep using KILL_WORKER
+        rules = []
+        if args.corrupt_rate > 0:
+            rules.append(Rule("corrupt_ckpt", rate=args.corrupt_rate))
+        if args.delay_ms > 0:
+            rules.append(Rule("delay", rate=1.0, ms=args.delay_ms,
+                              plane="round"))
+        faults = FaultInjector(rules, rng=chaos_rng) if rules else None
         svc = ProofService(port=0, prover_workers=args.workers, chaos=True,
                            allow_remote_shutdown=True,
-                           store_dir=args.store_dir).start()
+                           store_dir=args.store_dir, faults=faults).start()
         host, port = "127.0.0.1", svc.port
+    elif args.corrupt_rate or args.delay_ms:
+        print(json.dumps({"ok": False,
+                          "error": "--corrupt-rate/--delay-ms need the "
+                                   "self-hosted server (they inject at "
+                                   "the pool's round boundaries)"}))
+        return 2
 
     key_cache, key_lock = {}, threading.Lock()
     results = []
     results_lock = threading.Lock()
+    # chaos kill decisions drawn up front (one shared seeded rng would
+    # race across submitter threads): deterministic per --chaos-seed
+    kill_marks = [chaos_rng.random() < args.kill_rate
+                  for _ in range(args.jobs)]
+
+    def chaos_kill(c, job_id, out):
+        """Poll until the job runs, then KILL_WORKER it — the prove must
+        still finish (checkpoint resume) and verify."""
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            st = c.status(job_id)
+            if st["state"] in ("done", "failed"):
+                return
+            if st["state"] == "running":
+                try:
+                    c.kill_worker(job_id=job_id)
+                    out["chaos_killed"] = True
+                except Exception:
+                    pass  # prove outran the kill: a no-op injection
+                return
+            time.sleep(0.01)
 
     def submitter(i):
         spec = dict(_MIX[i % len(_MIX)])
@@ -91,6 +145,8 @@ def main():
         try:
             with ServiceClient(host, port) as c:
                 out["job_id"] = c.submit(spec)["job_id"]
+                if kill_marks[i]:
+                    chaos_kill(c, out["job_id"], out)
                 st = c.wait(out["job_id"], timeout_s=args.timeout)
                 out["state"] = st["state"]
                 out["retries"] = st["retries"]
@@ -166,6 +222,16 @@ def main():
         ok = ok and kill_report["state"] == "done" \
             and kill_report.get("verified") \
             and kill_report["retries"] >= 1
+    ctr = metrics["counters"]
+    recoveries = {
+        "job_retries": ctr.get("job_retries", 0),
+        "checkpoint_saves": ctr.get("checkpoint_saves", 0),
+        "checkpoint_resumes": ctr.get("checkpoint_resumes", 0),
+        "ckpt_corruptions_detected": ctr.get("faults_ckpt_corrupted", 0),
+        "faults_injected": {k[len("faults_injected_"):]: v
+                            for k, v in ctr.items()
+                            if k.startswith("faults_injected_")},
+    }
     summary = {
         "ok": ok,
         "wall_s": round(time.time() - t0, 3),
@@ -173,6 +239,16 @@ def main():
         "verified": verified,
         "failed": [r for r in results if not r.get("verified")],
         "kill": kill_report,
+        # chaos soak report: what was injected, what the service survived
+        # (every proof above still had to verify for ok=true)
+        "chaos": {
+            "kill_rate": args.kill_rate,
+            "corrupt_rate": args.corrupt_rate,
+            "delay_ms": args.delay_ms,
+            "kills_marked": sum(kill_marks),
+            "kills_landed": sum(1 for r in results if r.get("chaos_killed")),
+            "recoveries": recoveries,
+        },
         # key_builds == bucket_misses: 0 on a warm-store rerun of the same
         # shape mix (the ISSUE-2 acceptance check; see --store-dir)
         "key_builds": metrics["counters"].get("bucket_misses", 0),

@@ -20,6 +20,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def parse_peers(arg):
+    """'host:port,host:port' -> [(host, port)], failing fast with a
+    message that names the flag (a forgotten port otherwise surfaces as
+    a bare int() traceback)."""
+    peers = []
+    for entry in arg.split(","):
+        host, sep, port = entry.strip().rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise SystemExit(
+                f"--store-peers: {entry.strip()!r} is not host:port")
+        peers.append((host, int(port)))
+    return peers
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default="127.0.0.1")
@@ -39,6 +53,12 @@ def main():
                     help="store byte budget (LRU eviction past it)")
     ap.add_argument("--bucket-cap", type=int, default=64,
                     help="max shape buckets resident in memory (LRU)")
+    ap.add_argument("--store-peers", default=None,
+                    help="comma-separated host:port peers speaking "
+                         "STORE_FETCH: on a bucket miss, pull the key "
+                         "blob from a warm peer (digest-verified) before "
+                         "paying for a full build — a scaled-out replica "
+                         "serves warm after one network copy")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--allow-remote-shutdown", action="store_true",
@@ -62,7 +82,9 @@ def main():
         verify_on_complete=args.verify,
         allow_remote_shutdown=args.allow_remote_shutdown,
         store_dir=args.store_dir, store_byte_budget=args.store_budget,
-        bucket_cap=args.bucket_cap).start()
+        bucket_cap=args.bucket_cap,
+        store_peers=parse_peers(args.store_peers)
+        if args.store_peers else None).start()
     print(json.dumps({"listening": f"{svc.host}:{svc.port}",
                       "workers": args.workers, "chaos": args.chaos,
                       "store": args.store_dir}),
