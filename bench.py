@@ -536,9 +536,63 @@ def service_roundtrip_main():
         finally:
             svc.shutdown()
 
+    def restart_recovery_run():
+        """The durable-service-plane canary (PR 7): crash the service at
+        the journal's ROUND2 occurrence mid-prove (in-process SIGKILL
+        analog), restart it on the same journal+store, and check the
+        recovered job resumes from its checkpoint (no round-1 re-prove)
+        to BYTE-IDENTICAL proof bytes. Returns (ok, resumes)."""
+        import time as _time
+        from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
+        from distributed_plonk_tpu.service.jobs import build_circuit
+        from distributed_plonk_tpu.prover import prove
+        from distributed_plonk_tpu.proof_io import serialize_proof
+        from distributed_plonk_tpu.backend.python_backend import PythonBackend
+
+        journal_dir = tempfile.mkdtemp(prefix="dpt-bench-journal-")
+        spec_obj = {"kind": "toy", "gates": 60, "seed": 44,
+                    "job_key": "bench-recovery"}
+        box = {}
+        faults = FaultInjector([Rule("kill", tag="ROUND2", plane="journal")],
+                               kill_cb=lambda _label: box["svc"].crash())
+        svc = ProofService(port=0, prover_workers=1, store_dir=store_dir,
+                           journal_dir=journal_dir, chaos=True,
+                           faults=faults)
+        box["svc"] = svc
+        svc.start()
+        try:
+            svc.submit_local(spec_obj)
+            deadline = _time.monotonic() + 120
+            while not svc._stopped.is_set() and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            if not svc._stopped.is_set():
+                return False, 0
+            svc2 = ProofService(port=0, prover_workers=1,
+                                store_dir=store_dir,
+                                journal_dir=journal_dir).start()
+            try:
+                job, deduped = svc2.submit_ex(spec_obj)
+                if not (deduped and job.done_event.wait(timeout=120)
+                        and job.state == "done"):
+                    return False, 0
+                m2 = svc2.metrics.snapshot()
+                resumes = m2["counters"].get("checkpoint_resumes", 0)
+                s = JobSpec.from_wire(spec_obj)
+                want = serialize_proof(prove(
+                    _random.Random(s.seed), build_circuit(s),
+                    build_bucket_keys(s)[1], PythonBackend()))
+                ok = (job.proof_bytes == want and resumes >= 1
+                      and "prove_round/round1" not in m2["histograms"])
+                return ok, resumes
+            finally:
+                svc2.shutdown()
+        finally:
+            shutil.rmtree(journal_dir, ignore_errors=True)
+
     try:
         cold_s, st, header, blob, m_cold = one_run(seed=42)
         warm_s, st_w, _hw, _bw, m_warm = one_run(seed=43)
+        recovery_ok, recovery_resumes = restart_recovery_run()
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
@@ -555,6 +609,11 @@ def service_roundtrip_main():
                 m_warm["counters"].get("bucket_misses", 0),
             "service_warm_disk_hits":
                 m_warm["counters"].get("bucket_disk_hits", 0),
+            # contract: a service crashed mid-prove recovers from journal
+            # + checkpoint to byte-identical proof bytes, no re-prove of
+            # completed rounds (the PR 7 durability canary)
+            "service_restart_recovery_ok": bool(recovery_ok),
+            "service_restart_resumes": recovery_resumes,
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
             "service_jobs_completed":

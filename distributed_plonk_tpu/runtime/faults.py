@@ -29,14 +29,28 @@ One injector object threads through both failure planes:
                       validation on files) must detect it and restart the
                       prove cleanly rather than resume garbage
 
+  journal plane (service/journal.py): `on_journal(rtype, label, job_id)`
+      runs right after each job-journal record is DURABLE (fsync'd).
+      `tag` matches the record type ("SUBMIT", "START", "ROUND", "DONE",
+      "SHED", "FAILED") or a round-qualified label ("ROUND2"). Actions:
+        kill    invoke the kill callback — scripts/serve.py registers
+                os._exit, so `DPT_FAULTS="kill:at=journal:tag=ROUND2"`
+                kills the SERVICE PROCESS at exactly that journal
+                occurrence (the restart-recovery test plane: the record
+                is on disk, nothing after it is)
+        delay   sleep `ms` (slow journal device)
+
 Rules come from code (tests) or from the environment:
 
     DPT_FAULTS="kill:tag=FFT1:worker=1:nth=1;delay:tag=MSM:ms=50"
+    DPT_FAULTS="kill:at=journal:tag=ROUND2"
 
-Entries are `action[:key=value]*` separated by `;`. Keys: tag (name or
-number), worker, nth (1-based occurrence; default 1), rate (probability,
-overrides nth), ms, max (max fires, default 1 for nth rules, unlimited
-for rate rules). Occurrence counting is per-rule and thread-safe.
+Entries are `action[:key=value]*` separated by `;`. Keys: tag (name,
+number, or — on the journal plane — a record label string), worker, nth
+(1-based occurrence; default 1), rate (probability, overrides nth), ms,
+max (max fires, default 1 for nth rules, unlimited for rate rules), at
+(plane: wire | round | journal). Occurrence counting is per-rule and
+thread-safe.
 """
 
 import os
@@ -92,16 +106,21 @@ class Rule:
 
     @classmethod
     def parse(cls, entry):
-        """'kill:tag=FFT1:worker=1:nth=2' -> Rule."""
+        """'kill:tag=FFT1:worker=1:nth=2' -> Rule. Tag resolution is
+        plane-aware (after all keys are read, since `at=` may follow
+        `tag=`): journal rules keep the record-label STRING — "SUBMIT"
+        is both a protocol tag name and a journal record type, and a
+        journal rule must match the latter."""
         parts = entry.strip().split(":")
         action, kvs = parts[0], parts[1:]
         kw = {}
+        tag_raw = None
         for kv in kvs:
             k, _, v = kv.partition("=")
             k = k.strip()
             v = v.strip()
             if k == "tag":
-                kw["tag"] = _TAG_NAMES[v] if v in _TAG_NAMES else int(v)
+                tag_raw = v
             elif k == "worker":
                 kw["worker"] = int(v)
             elif k == "nth":
@@ -116,6 +135,13 @@ class Rule:
                 kw["plane"] = v
             else:
                 raise ValueError(f"unknown fault key {k!r} in {entry!r}")
+        if tag_raw is not None:
+            if kw.get("plane") == "journal":
+                kw["tag"] = tag_raw                 # record label string
+            elif tag_raw in _TAG_NAMES:
+                kw["tag"] = _TAG_NAMES[tag_raw]     # protocol tag name
+            else:
+                kw["tag"] = int(tag_raw)
         return cls(action, **kw)
 
 
@@ -202,6 +228,30 @@ class FaultInjector:
             elif rule.action == "corrupt_ckpt" and checkpoint is not None:
                 if checkpoint.chaos_corrupt():
                     self._inc("faults_ckpt_corrupted")
+
+    # -- journal plane (proof-service job journal) ----------------------------
+
+    def on_journal(self, rtype, label, job_id=None):
+        """Post-append hook: `tag` in journal rules matches either the
+        bare record type ("ROUND": any round) or the qualified label
+        ("ROUND2": that round exactly). The record is already durable
+        when this runs, so a kill here models a crash with this
+        transition journaled and nothing after it."""
+        for rule in self.rules:
+            if rule.plane != "journal":
+                continue
+            if rule.tag is not None and rule.tag not in (rtype, label):
+                continue
+            # tag match done above (two aliases per occurrence); _due only
+            # does the nth/rate/max bookkeeping
+            if not self._due(rule, tag=rule.tag):
+                continue
+            self._inc(f"faults_injected_{rule.action}")
+            if rule.action == "delay":
+                time.sleep(rule.ms / 1000.0)  # analysis: ok(host-only ms->s)
+            elif rule.action == "kill":
+                if self.kill_cb is not None:
+                    self.kill_cb(label)
 
     def counts(self):
         with self._lock:

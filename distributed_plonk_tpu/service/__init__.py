@@ -23,16 +23,17 @@ tests/test_store.py pins the warm-start contracts.
 """
 
 from .jobs import Job, JobSpec, build_circuit, build_bucket_keys, shape_key
+from .journal import JobJournal
 from .queue import JobQueue, Rejected
 from .metrics import Metrics
-from .pool import WorkerPool, WorkerKilled, JobTimeout
+from .pool import WorkerPool, WorkerKilled, JobTimeout, WorkerDrained
 from .scheduler import BucketCache, Scheduler
 from .server import ProofService
 from .client import ServiceClient
 
 __all__ = [
     "Job", "JobSpec", "build_circuit", "build_bucket_keys", "shape_key",
-    "JobQueue", "Rejected", "Metrics", "WorkerPool", "WorkerKilled",
-    "JobTimeout", "BucketCache", "Scheduler", "ProofService",
-    "ServiceClient",
+    "JobJournal", "JobQueue", "Rejected", "Metrics", "WorkerPool",
+    "WorkerKilled", "JobTimeout", "WorkerDrained", "BucketCache",
+    "Scheduler", "ProofService", "ServiceClient",
 ]

@@ -98,12 +98,13 @@ class ServiceClient:
         self._call(protocol.SHUTDOWN)
 
     def wait(self, job_id, timeout_s=120, poll_s=0.05):
-        """Poll STATUS until the job leaves the queue/running states;
-        returns the final status dict. Raises TimeoutError."""
+        """Poll STATUS until the job reaches a terminal state (done,
+        failed, or a shed TTL verdict); returns the final status dict.
+        Raises TimeoutError."""
         deadline = time.monotonic() + timeout_s
         while True:
             st = self.status(job_id)
-            if st["state"] in ("done", "failed"):
+            if st["state"] in ("done", "failed", "shed"):
                 return st
             if time.monotonic() > deadline:
                 raise TimeoutError(f"{job_id} still {st['state']}")

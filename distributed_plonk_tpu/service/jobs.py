@@ -40,13 +40,30 @@ _SPEC_KINDS = ("toy", "merkle")
 
 
 class JobSpec:
-    """Validated job description (the SUBMIT payload)."""
+    """Validated job description (the SUBMIT payload).
 
-    def __init__(self, kind, params, seed, priority=0):
+    Beyond the shape/witness fields, a spec may carry two durability
+    knobs (both excluded from the shape key — they change nothing about
+    the circuit):
+      job_key  client-supplied idempotency key: two SUBMITs with the same
+               job_key are ONE job, across retries, reconnects, and
+               service restarts (the journal persists the mapping) — the
+               duplicate is answered from the existing job or its
+               finished-proof artifact, never re-proved.
+      ttl_s    deadline budget in seconds from submission: a job that has
+               not STARTED proving within its TTL is load-shed with a
+               journaled, queryable SHED verdict instead of burning a
+               worker on an answer nobody is waiting for.
+    """
+
+    def __init__(self, kind, params, seed, priority=0, job_key=None,
+                 ttl_s=None):
         self.kind = kind
         self.params = params  # shape-determining, seed excluded
         self.seed = seed
         self.priority = priority
+        self.job_key = job_key
+        self.ttl_s = ttl_s
 
     @classmethod
     def from_wire(cls, obj):
@@ -61,6 +78,15 @@ class JobSpec:
         priority = obj.get("priority", 0)
         if not isinstance(seed, int) or not isinstance(priority, int):
             raise ValueError("seed and priority must be integers")
+        job_key = obj.get("job_key")
+        if job_key is not None and not (isinstance(job_key, str)
+                                        and 0 < len(job_key) <= 128):
+            raise ValueError("job_key must be a 1..128 char string")
+        ttl_s = obj.get("ttl_s")
+        if ttl_s is not None:
+            if not isinstance(ttl_s, (int, float)) or not ttl_s > 0:
+                raise ValueError("ttl_s must be a positive number")
+            ttl_s = float(ttl_s)
         if kind == "toy":
             gates = obj.get("gates")
             if not isinstance(gates, int) or not 1 <= gates <= 1 << 16:
@@ -80,11 +106,16 @@ class JobSpec:
                 raise ValueError("num_leaves must be a positive integer")
             params = {"height": height, "num_proofs": num_proofs,
                       "num_leaves": num_leaves}
-        return cls(kind, params, seed, priority)
+        return cls(kind, params, seed, priority, job_key=job_key,
+                   ttl_s=ttl_s)
 
     def to_wire(self):
         out = {"kind": self.kind, "seed": self.seed,
                "priority": self.priority}
+        if self.job_key is not None:
+            out["job_key"] = self.job_key
+        if self.ttl_s is not None:
+            out["ttl_s"] = self.ttl_s
         out.update(self.params)
         return out
 
@@ -144,6 +175,9 @@ QUEUED = "queued"
 RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
+SHED = "shed"        # deadline/TTL load shedding: a journaled, queryable
+                     # verdict (STATUS reports it like done/failed)
+TERMINAL = (DONE, FAILED, SHED)
 
 _job_seq = itertools.count(1)
 # per-process run token in every job id: ids (and so checkpoint file
@@ -157,11 +191,20 @@ class Job:
     (server accept thread -> scheduler -> pool worker); `status()` builds
     the externally visible JSON snapshot."""
 
-    def __init__(self, spec):
-        self.id = "job-%s-%06d" % (_RUN_TOKEN, next(_job_seq))
+    def __init__(self, spec, job_id=None):
+        # job_id: journal recovery reuses the ORIGINAL id so the job's
+        # checkpoint artifact (ckpt:<id>) and finished-proof artifact
+        # (proof:<id>) still address its state from the previous process
+        self.id = job_id or "job-%s-%06d" % (_RUN_TOKEN, next(_job_seq))
         self.spec = spec
         self.shape_key = shape_key(spec)
         self.priority = spec.priority
+        self.job_key = spec.job_key
+        # wall clock, not monotonic: the deadline must survive a service
+        # restart (the journal carries it; a recovered job whose TTL
+        # expired during the outage is shed, not resumed)
+        self.deadline_ts = (time.time() + spec.ttl_s
+                            if spec.ttl_s is not None else None)
         self.state = QUEUED
         self.submitted_at = time.monotonic()
         self.scheduled_at = None
@@ -206,6 +249,23 @@ class Job:
         self.finished_at = time.monotonic()
         self.done_event.set()
 
+    def finish_shed(self, reason):
+        """Terminal load-shed verdict (deadline/TTL): clients polling
+        STATUS see state=shed + the reason, same shape as a failure."""
+        self.error = reason
+        self.state = SHED
+        self.finished_at = time.monotonic()
+        self.done_event.set()
+
+    def expired(self, now=None):
+        """True once the job's TTL deadline has passed (never for jobs
+        without one). Checked before key build and before each prove
+        attempt — not during one (a started prove is worth finishing:
+        its result is cacheable under the job_key)."""
+        if self.deadline_ts is None:
+            return False
+        return (now if now is not None else time.time()) > self.deadline_ts
+
     def status(self):
         return {
             "job_id": self.id,
@@ -213,6 +273,8 @@ class Job:
             "spec": self.spec.to_wire(),
             "shape_key": [str(p) for p in self.shape_key],
             "priority": self.priority,
+            "job_key": self.job_key,
+            "deadline_ts": self.deadline_ts,
             "retries": self.retries,
             "attempts": list(self.attempts),
             "worker": self.worker,

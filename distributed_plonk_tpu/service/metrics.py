@@ -24,6 +24,25 @@ story reads off one snapshot):
                                              attempts (service pool)
     faults_injected_* / faults_ckpt_corrupted  chaos-injection activity
                                              (runtime/faults.py)
+
+Durability vocabulary (service/journal.py + the restart-recovery path):
+    journal_appends / journal_replays        records written / replayed
+                                             at open
+    journal_torn_records / journal_compactions  damaged-tail truncations
+                                             and log rewrites
+    jobs_recovered / jobs_recovered_finished re-enqueued in-flight jobs
+                                             and artifact-served DONE
+                                             jobs after a restart
+    jobs_shed                                TTL/deadline load-shed
+                                             verdicts (journaled)
+    dedup_hits                               duplicate job_key SUBMITs
+                                             answered from the original
+    drain_started / drain_clean / drain_forced  graceful-drain outcomes
+    jobs_drain_parked                        in-flight jobs checkpointed
+                                             + parked by a forced drain
+    proof_artifacts_lost                     DONE records whose proof
+                                             artifact was evicted (job
+                                             re-proved, same bytes)
 """
 
 import random

@@ -33,6 +33,7 @@ from ..prover import prove
 from ..proof_io import serialize_proof
 from ..trace import Tracer
 from . import jobs as J
+from . import journal as JN
 
 
 class WorkerKilled(Exception):
@@ -41,6 +42,12 @@ class WorkerKilled(Exception):
 
 class JobTimeout(Exception):
     pass
+
+
+class WorkerDrained(Exception):
+    """Graceful drain hit its deadline: the worker stops at the next
+    round boundary (snapshot already durable) and the job stays
+    journaled as in-flight — the restarted service resumes it."""
 
 
 def _default_backend():
@@ -53,13 +60,17 @@ class _GuardHooks:
     backend: kill flags and deadlines fire AFTER the round's snapshot is
     durable (so the subsequent retry has the maximum state to resume
     from), the fault injector's checkpoint plane (slow-prover delay,
-    snapshot corruption) runs at the same boundary, and resumes/saves
-    land in the metrics registry."""
+    snapshot corruption) runs at the same boundary, the job journal's
+    ROUND record is appended (snapshot first, THEN the journal's promise
+    that it exists), and resumes/saves land in the metrics registry."""
 
-    def _arm_guard(self, worker, metrics=None, faults=None):
+    def _arm_guard(self, worker, metrics=None, faults=None, journal=None,
+                   job_id=None):
         self.worker = worker
         self._metrics = metrics
         self._faults = faults
+        self._journal = journal
+        self._job_id = job_id
         return self
 
     def load(self, fingerprint):
@@ -75,31 +86,38 @@ class _GuardHooks:
         super().save(round_no, *args, **kwargs)
         if self._metrics is not None:
             self._metrics.inc("checkpoint_saves")
+        if self._journal is not None:
+            # write-ahead contract: the snapshot IS durable at this point,
+            # so a crash at (or any time after) this journal append finds
+            # resume-from-round-N state in the store/ckpt file
+            self._journal.append(JN.ROUND, self._job_id, round=round_no)
         if self._faults is not None:
             self._faults.on_round(round_no, checkpoint=self)
         self.worker.check(round_no=round_no)
 
 
 class _GuardedCheckpoint(_GuardHooks, ProverCheckpoint):
-    def __init__(self, path, worker, metrics=None, faults=None):
+    def __init__(self, path, worker, metrics=None, faults=None,
+                 journal=None, job_id=None):
         super().__init__(path)
-        self._arm_guard(worker, metrics, faults)
+        self._arm_guard(worker, metrics, faults, journal, job_id)
 
 
 class _GuardedStoreCheckpoint(_GuardHooks, StoreCheckpoint):
     """Store-backed variant: snapshots are content-addressed artifacts
     (SHA-verified, budget-shared, STORE_FETCHable by a replacement host)."""
 
-    def __init__(self, store, name, worker, metrics=None, faults=None):
+    def __init__(self, store, name, worker, metrics=None, faults=None,
+                 journal=None, job_id=None):
         super().__init__(store, name)
-        self._arm_guard(worker, metrics, faults)
+        self._arm_guard(worker, metrics, faults, journal, job_id)
 
 
 class _Worker:
     """One pool slot's current thread. A killed slot respawns as a new
     generation (`w2g1` -> `w2g2`) — the slot is permanent, threads are not."""
 
-    def __init__(self, index, generation):
+    def __init__(self, index, generation, drain_stop=None):
         self.index = index
         self.generation = generation
         self.name = f"w{index}g{generation}"
@@ -107,6 +125,10 @@ class _Worker:
         self.deadline = None
         self.busy_job = None
         self.thread = None
+        # pool-wide forced-drain flag: set once the drain deadline passes,
+        # observed here at round boundaries (the snapshot just became
+        # durable — the cheapest possible point to stop)
+        self.drain_stop = drain_stop
 
     def check(self, round_no=None):
         arm = self.kill_arm
@@ -114,6 +136,8 @@ class _Worker:
                                 or arm["at_round"] == round_no):
             self.kill_arm = None
             raise WorkerKilled(self.name)
+        if self.drain_stop is not None and self.drain_stop.is_set():
+            raise WorkerDrained(self.name)
         if self.deadline is not None and time.monotonic() > self.deadline:
             raise JobTimeout(f"deadline exceeded on {self.name}")
 
@@ -124,7 +148,8 @@ _STOP = object()
 class WorkerPool:
     def __init__(self, metrics, prover_workers=2, max_retries=2,
                  job_timeout_s=None, ckpt_dir=None, backend_factory=None,
-                 verify_on_complete=False, store=None, faults=None):
+                 verify_on_complete=False, store=None, faults=None,
+                 journal=None):
         self.metrics = metrics
         self.max_retries = max_retries
         self.job_timeout_s = job_timeout_s
@@ -134,6 +159,9 @@ class WorkerPool:
         # path remains the storeless fallback
         self.store = store
         self.faults = faults
+        # journal: service job journal (service/journal.py) — the pool
+        # appends START/ROUND/DONE/SHED/FAILED; None runs journal-free
+        self.journal = journal
         self.ckpt_dir = ckpt_dir or tempfile.mkdtemp(prefix="dpt-service-ck-")
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self.backend_factory = backend_factory or _default_backend
@@ -145,13 +173,14 @@ class WorkerPool:
         self._lock = threading.Lock()
         self._workers = []
         self._stopping = False
+        self._drain_stop = threading.Event()
         for i in range(prover_workers):
             self._workers.append(self._spawn(i, 1))
 
     # -- lifecycle ------------------------------------------------------------
 
     def _spawn(self, index, generation):
-        w = _Worker(index, generation)
+        w = _Worker(index, generation, drain_stop=self._drain_stop)
         w.thread = threading.Thread(target=self._loop, args=(w,),
                                     name=f"pool-{w.name}", daemon=True)
         w.thread.start()
@@ -177,6 +206,44 @@ class WorkerPool:
             self._dispatch_q.put(_STOP)
         for w in workers:
             w.thread.join(timeout=10)
+
+    def crash(self):
+        """Crash simulation (ProofService.crash): workers stop at their
+        next round boundary through the DRAIN path — which parks the job
+        with no retry bookkeeping, no terminal journal records, and
+        crucially no checkpoint clears (a real dead process can't delete
+        the snapshots its successor resumes from)."""
+        with self._lock:
+            self._stopping = True
+        self._drain_stop.set()
+
+    def busy(self):
+        """Names of workers currently holding a job."""
+        with self._lock:
+            pool = list(self._workers)
+        return [w.name for w in pool if w.busy_job is not None]
+
+    def drain(self, deadline):
+        """Graceful drain: let in-flight proves finish until `deadline`
+        (monotonic), then force the stragglers to stop at their next
+        round boundary — the snapshot is durable and the journal still
+        shows them in-flight, so a restart resumes with zero recompute.
+        Returns True iff everything finished without the forced stop."""
+        clean = True
+        while self.busy() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if self.busy():
+            clean = False
+            self._drain_stop.set()
+            # round boundaries are the check points; wait for the busy
+            # set to clear, bounded (a worker inside one long round can
+            # exceed this — threads are daemons, the journal is already
+            # consistent either way)
+            stop_wait = time.monotonic() + 10
+            while self.busy() and time.monotonic() < stop_wait:
+                time.sleep(0.02)
+        self.shutdown()
+        return clean
 
     def workers(self):
         with self._lock:
@@ -219,9 +286,12 @@ class WorkerPool:
         if self.store is not None:
             return _GuardedStoreCheckpoint(self.store, job.id, worker,
                                            metrics=self.metrics,
-                                           faults=self.faults)
+                                           faults=self.faults,
+                                           journal=self.journal,
+                                           job_id=job.id)
         return _GuardedCheckpoint(self._ckpt_path(job), worker,
-                                  metrics=self.metrics, faults=self.faults)
+                                  metrics=self.metrics, faults=self.faults,
+                                  journal=self.journal, job_id=job.id)
 
     def _clear_ckpt(self, job):
         if self.store is not None:
@@ -232,6 +302,17 @@ class WorkerPool:
         except OSError:
             pass
 
+    def shed(self, job, reason):
+        """Terminal TTL/deadline verdict: journaled (clients can query it
+        across a restart), counted, never proved. Shared by the scheduler
+        (expired before key build) and the pool loop (expired in the
+        dispatch buffer)."""
+        self.metrics.inc("jobs_shed")
+        if self.journal is not None:
+            self.journal.append(JN.SHED, job.id, reason=reason)
+        self._clear_ckpt(job)
+        job.finish_shed(reason)
+
     def _loop(self, worker):
         backend = self.backend_factory()
         while True:
@@ -239,17 +320,34 @@ class WorkerPool:
             if item is _STOP:
                 return
             job, res = item
+            if job.expired():
+                self.shed(job, "ttl expired before prove start")
+                continue
             worker.busy_job = job
             if job.started_at is None:
                 job.started_at = time.monotonic()
                 self.metrics.observe("job_wait", job.wait_s)
             job.worker = worker.name
             job.state = J.RUNNING
+            if self.journal is not None:
+                self.journal.append(JN.START, job.id, worker=worker.name)
             try:
                 self._run_attempt(worker, backend, job, res)
                 job.attempts.append({"worker": worker.name, "outcome": "ok"})
                 self.metrics.inc("jobs_completed")
                 self.metrics.observe("job_run", job.run_s)
+            except WorkerDrained:
+                # deadline-forced drain: the round snapshot is durable and
+                # the job's journal entry still reads in-flight — park it
+                # (no requeue, no terminal record); the restarted service
+                # resumes it from the checkpoint
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "drained"})
+                job.state = J.QUEUED
+                job.worker = None
+                worker.busy_job = None
+                self.metrics.inc("jobs_drain_parked")
+                return  # draining: this thread is done
             except WorkerKilled:
                 job.attempts.append({"worker": worker.name,
                                      "outcome": "killed"})
@@ -298,6 +396,8 @@ class WorkerPool:
     def _fail(self, job, reason):
         self.metrics.inc("jobs_failed")
         self._clear_ckpt(job)
+        if self.journal is not None:
+            self.journal.append(JN.FAILED, job.id, reason=reason)
         job.finish_err(reason)
 
     def _run_attempt(self, worker, backend, job, res):
@@ -324,6 +424,35 @@ class WorkerPool:
                     "proof failed server-side verification"
             totals = tracer.totals(depth=1)
             self.metrics.observe_rounds(totals)
-            job.finish_ok(serialize_proof(proof), ckt.public_input(), totals)
+            proof_bytes = serialize_proof(proof)
+            pub = ckt.public_input()
+            self._journal_done(job, proof_bytes, pub)
+            job.finish_ok(proof_bytes, pub, totals)
         finally:
             worker.deadline = None
+
+    def _journal_done(self, job, proof_bytes, pub):
+        """Finished-proof durability, BEFORE the client-visible state
+        flips to done: the proof becomes a content-addressed store
+        artifact (STORE_FETCHable cross-host; a restart serves it
+        instead of re-proving) and the journal DONE record carries its
+        digest — or, storeless, the raw bytes inline (944B per proof:
+        small enough that the journal stays the single durable surface).
+        A crash anywhere before the DONE append re-proves from the
+        round-4 snapshot and lands on the identical bytes."""
+        if self.journal is None:
+            return
+        fields = {"pub": [hex(x) for x in pub], "retries": job.retries}
+        if self.store is not None:
+            from ..store import keycache as KC
+            try:
+                fields["digest"] = KC.store_proof(
+                    self.store, job.id, proof_bytes, pub,
+                    spec_wire=job.spec.to_wire(), retries=job.retries)
+                fields["store_key"] = KC.proof_store_key(job.id)
+            except Exception:  # pragma: no cover - environmental (disk)
+                self.metrics.inc("store_write_errors")
+                fields["proof_hex"] = proof_bytes.hex()
+        else:
+            fields["proof_hex"] = proof_bytes.hex()
+        self.journal.append(JN.DONE, job.id, **fields)

@@ -36,12 +36,15 @@ class JobQueue:
         with self._lock:
             return len(self._items)
 
-    def submit(self, job):
-        """Enqueue or raise Rejected (queue_full | draining)."""
+    def submit(self, job, force=False):
+        """Enqueue or raise Rejected (queue_full | draining). force=True
+        bypasses the depth cap — journal recovery re-enqueues every job
+        the previous process had already admitted; bouncing them against
+        this process's depth limit would turn a restart into data loss."""
         with self._lock:
             if self._closed:
                 raise Rejected("draining")
-            if len(self._items) >= self.max_depth:
+            if not force and len(self._items) >= self.max_depth:
                 raise Rejected("queue_full")
             self._seq += 1
             # negative priority first => higher priority pops first

@@ -47,6 +47,19 @@ class BucketResources:
         self.build_s = build_s
 
 
+class _KeyLatch:
+    """One shape's in-flight load/build: later callers of the same shape
+    wait on `done` instead of re-running the setup; callers of OTHER
+    shapes never see it at all (the cache lock is held only for map
+    bookkeeping, never across the load/fetch/build work)."""
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.res = None
+        self.source = None
+        self.error = None
+
+
 class BucketCache:
     """Three-tier shape-bucket key cache: memory -> disk -> build.
 
@@ -58,9 +71,18 @@ class BucketCache:
     integrity failures there self-heal (the corrupt entry is deleted and
     the build tier repopulates it). Tier 3 is `jobs.build_bucket_keys`.
 
+    Concurrency: the load/peer-fetch/build tiers run OUTSIDE the cache
+    lock behind a per-key latch. Concurrent first-touch of one shape
+    still does exactly one setup (waiters block on that shape's latch),
+    but a cold miss against an unreachable peer no longer stalls other
+    shapes' lookups for DPT_PEER_FETCH_TIMEOUT_MS per peer — the
+    PR 6 ROADMAP remainder this closes (regression-tested by
+    tests/test_service.py's timing-bound latch tests).
+
     Metrics: bucket_hits (memory), bucket_disk_hits, bucket_misses
-    (full build), bucket_mem_evictions, plus the store's own store_*
-    counters/gauges.
+    (full build), bucket_latch_waits (blocked on another caller's
+    in-flight setup of the same shape), bucket_mem_evictions, plus the
+    store's own store_* counters/gauges.
     """
 
     def __init__(self, metrics, backend=None, store=None, max_entries=None,
@@ -77,6 +99,7 @@ class BucketCache:
         self.max_entries = max_entries
         self._lock = threading.Lock()
         self._buckets = OrderedDict()
+        self._latches = {}
 
     def get(self, spec):
         """Resources for the spec's shape, loading/building on first use."""
@@ -92,16 +115,39 @@ class BucketCache:
                 self._buckets.move_to_end(key)
                 self.metrics.inc("bucket_hits")
                 return res, "memory"
-            # load/build inside the lock: concurrent first-touch of one
-            # shape must not duplicate a key setup (the expensive part)
+            latch = self._latches.get(key)
+            owner = latch is None
+            if owner:
+                latch = self._latches[key] = _KeyLatch()
+        if not owner:
+            # same shape already loading on another thread: wait on ITS
+            # latch (off-lock — other shapes proceed), then share the
+            # outcome. A builder failure propagates: the latch is gone,
+            # so a later retry re-attempts the build fresh.
+            self.metrics.inc("bucket_latch_waits")
+            latch.done.wait()
+            if latch.error is not None:
+                raise latch.error
+            return latch.res, latch.source
+        try:
             res, source = self._load_or_build(spec, key)
+        except BaseException as e:
+            with self._lock:
+                self._latches.pop(key, None)
+            latch.error = e
+            latch.done.set()
+            raise
+        with self._lock:
             self._buckets[key] = res
+            self._latches.pop(key, None)
             if self.max_entries is not None \
                     and len(self._buckets) > self.max_entries:
                 self._buckets.popitem(last=False)  # LRU out
                 self.metrics.inc("bucket_mem_evictions")
             self.metrics.gauge("buckets_resident", len(self._buckets))
-            return res, source
+        latch.res, latch.source = res, source
+        latch.done.set()
+        return res, source
 
     def _load_or_build(self, spec, key):
         if self.store is not None:
@@ -133,11 +179,10 @@ class BucketCache:
         return res, "built"
 
     # per-peer dial+transfer budget for the fetch tier. Peer fetch runs
-    # under the cache lock (build dedup), so an unreachable peer stalls
-    # OTHER shapes' lookups for this long per peer per cold miss — keep
-    # it far below fetch_into's 30 s default. (Moving the fetch/build
-    # outside the lock behind a per-key latch is the structural fix,
-    # tracked in ROADMAP direction 2.)
+    # off-lock behind the shape's own latch (so an unreachable peer only
+    # delays THAT shape's first-touch callers), but the budget still
+    # bounds how long a cold miss can hang on one dead peer before the
+    # build tier takes over — keep it far below fetch_into's 30 s default.
     PEER_TIMEOUT_MS = int(os.environ.get("DPT_PEER_FETCH_TIMEOUT_MS", "5000"))
 
     def _fetch_from_peers(self, key):
@@ -179,10 +224,27 @@ class Scheduler:
         self.queue.close()
         self._thread.join(timeout=10)
 
+    def crash(self):
+        """Crash simulation: stop scheduling without the join/close
+        bookkeeping (the 'process' is gone, not exiting)."""
+        self._stop.set()
+
     def _loop(self):
         while not self._stop.is_set():
             batch = self.queue.pop_batch(self.max_batch, timeout=0.25)
             self.metrics.gauge("queue_depth", self.queue.depth())
+            if not batch:
+                continue
+            # TTL load shedding happens HERE, before the (possibly
+            # expensive) key build: a job whose deadline lapsed in the
+            # queue gets a journaled SHED verdict, not a worker
+            live = []
+            for job in batch:
+                if job.expired():
+                    self.pool.shed(job, "ttl expired in queue")
+                else:
+                    live.append(job)
+            batch = live
             if not batch:
                 continue
             # the scheduler is ONE thread: an unguarded exception here

@@ -9,6 +9,14 @@ the RESULT reply carries the 944-byte proof_io layout after a JSON header.
 `ProofService` is also directly embeddable (tests/test_service.py,
 bench.py drive it in-process through `submit_local`/the client): the TCP
 listener is just one more producer into the queue.
+
+Durability (PR 7): with `journal_dir`, every job transition is journaled
+write-ahead (service/journal.py) — a crashed/restarted frontend replays
+the journal, resumes in-flight jobs from their store checkpoints, serves
+finished jobs from content-addressed proof artifacts, dedups resubmitted
+job_keys, sheds expired TTLs with a queryable verdict, and drains
+gracefully on SIGTERM (scripts/serve.py). `crash()` is the in-process
+SIGKILL analog the restart tests and bench canary use.
 """
 
 import os
@@ -17,6 +25,8 @@ import time
 
 from ..runtime import native, protocol
 from ..store import ArtifactStore, aot_warmup, remote
+from . import jobs as J
+from . import journal as JN
 from .jobs import Job, JobSpec
 from .metrics import Metrics
 from .pool import WorkerPool
@@ -31,7 +41,7 @@ class ProofService:
                  backend_factory=None, verify_on_complete=False,
                  finished_retention=4096, allow_remote_shutdown=False,
                  store_dir=None, store_byte_budget=None, bucket_cap=64,
-                 store_peers=None, faults=None):
+                 store_peers=None, faults=None, journal_dir=None):
         self.host = host
         self.port = port
         self.chaos = chaos
@@ -50,19 +60,30 @@ class ProofService:
                                        byte_budget=store_byte_budget,
                                        metrics=self.metrics.scoped("store"))
         # faults: runtime.faults.FaultInjector (chaos mode only) — the
-        # pool runs its checkpoint-plane rules at round boundaries. An
+        # pool runs its checkpoint-plane rules at round boundaries and
+        # the journal its journal-plane rules after each append. An
         # injector built without a metrics registry adopts ours, so its
         # faults_injected_*/faults_ckpt_corrupted counters show up in the
         # same METRICS snapshot as the recovery counters they provoke.
         self.faults = faults if chaos else None
         if self.faults is not None and self.faults.metrics is None:
             self.faults.metrics = self.metrics
+        # journal: the crash-safety spine (service/journal.py). Replays
+        # on open; `start()` then recovers every journaled job — queued
+        # and in-flight ones resume from their checkpoints, finished ones
+        # serve from their proof artifacts. Without a journal_dir the
+        # service keeps the PR-1 in-memory-only behavior.
+        self.journal = None
+        if journal_dir is not None:
+            self.journal = JN.JobJournal(journal_dir, metrics=self.metrics,
+                                         retain_terminal=finished_retention,
+                                         chaos=self.faults)
         self.pool = WorkerPool(
             self.metrics, prover_workers=prover_workers,
             max_retries=max_retries, job_timeout_s=job_timeout_s,
             ckpt_dir=ckpt_dir, backend_factory=backend_factory,
             verify_on_complete=verify_on_complete, store=self.store,
-            faults=self.faults)
+            faults=self.faults, journal=self.journal)
         # store_peers: [(host, port)] of peers speaking STORE_FETCH — a
         # bucket miss tries a network copy from a warm peer before paying
         # for a full key build (elastic scale-out: a fresh host serves
@@ -75,8 +96,16 @@ class ProofService:
         self._warm_backend = None
         self._warm_backend_lock = threading.Lock()
         self.jobs = {}
+        self._job_keys = {}   # idempotency: job_key -> job_id (journaled)
         self.finished_retention = finished_retention
         self._jobs_lock = threading.Lock()
+        # serializes the whole admission sequence (dedup check -> journal
+        # SUBMIT -> queue insert), so a concurrent duplicate can never
+        # dedup onto a job that is still mid-admission (and might yet be
+        # rejected and rolled back, or not yet journaled — its positive
+        # ack must imply the write-ahead record exists). Distinct from
+        # _jobs_lock so STATUS lookups never wait behind an fsync.
+        self._submit_lock = threading.Lock()
         self._listener = None
         self._stopped = threading.Event()
 
@@ -85,38 +114,79 @@ class ProofService:
     def submit_local(self, spec_obj):
         """Validate + admit one job; returns the Job. Raises ValueError
         (bad spec) or Rejected (admission control)."""
+        return self.submit_ex(spec_obj)[0]
+
+    def submit_ex(self, spec_obj):
+        """(job, deduped): like submit_local, but reports whether the
+        spec's job_key matched an existing job (idempotent submission —
+        the duplicate gets the ORIGINAL job, which may already be done
+        and served from its finished-proof artifact, even across a
+        service restart)."""
         spec = JobSpec.from_wire(spec_obj)
         job = Job(spec)
-        self.metrics.inc("jobs_submitted")
-        try:
-            self.queue.submit(job)
-        except Rejected:
-            self.metrics.inc("jobs_rejected")
-            raise
+        with self._submit_lock:
+            with self._jobs_lock:
+                if spec.job_key is not None:
+                    existing = self.jobs.get(
+                        self._job_keys.get(spec.job_key))
+                    if existing is not None:
+                        self.metrics.inc("dedup_hits")
+                        return existing, True
+                    self._job_keys[spec.job_key] = job.id
+                self._register_locked(job)
+            self.metrics.inc("jobs_submitted")
+            # write-ahead: journal the admission BEFORE the in-memory
+            # queue sees it — a crash on the next line recovers the job;
+            # the reverse order would ack a job a restart has never
+            # heard of
+            if self.journal is not None:
+                self.journal.append(JN.SUBMIT, job.id, spec=spec.to_wire(),
+                                    key=spec.job_key,
+                                    deadline=job.deadline_ts,
+                                    ts=time.time())
+            try:
+                self.queue.submit(job)
+            except Rejected as e:
+                self.metrics.inc("jobs_rejected")
+                if self.journal is not None:
+                    # terminal verdict so replay never resurrects a job
+                    # the client was told was refused
+                    self.journal.append(JN.SHED, job.id,
+                                        reason=JN.REJECTED_PREFIX + e.reason)
+                with self._jobs_lock:
+                    self.jobs.pop(job.id, None)
+                    if spec.job_key is not None \
+                            and self._job_keys.get(spec.job_key) == job.id:
+                        del self._job_keys[spec.job_key]
+                raise
         self.metrics.inc("jobs_accepted")
         self.metrics.gauge("queue_depth", self.queue.depth())
-        with self._jobs_lock:
-            self.jobs[job.id] = job
-            # bound the job table in a long-running daemon: evict the
-            # oldest FINISHED jobs (dict preserves insertion order) once
-            # past the retention cap — live jobs are never evicted, and
-            # admission control already bounds how many can be live
-            excess = len(self.jobs) - self.finished_retention
-            if excess > 0:
-                # oldest-first (dict insertion order), stop as soon as the
-                # excess is covered — finished jobs cluster at the front,
-                # so this stays O(excess + live prefix), not O(table)
-                evict = []
-                for jid, j in self.jobs.items():
-                    if len(evict) >= excess:
-                        break
-                    if j.state in ("done", "failed"):
-                        evict.append(jid)
-                for jid in evict:
-                    del self.jobs[jid]
-                if evict:
-                    self.metrics.inc("jobs_evicted", len(evict))
-        return job
+        return job, False
+
+    def _register_locked(self, job):
+        """Insert into the job table (caller holds _jobs_lock) and bound
+        it: evict the oldest FINISHED jobs (dict preserves insertion
+        order) once past the retention cap — live jobs are never evicted,
+        and admission control already bounds how many can be live."""
+        self.jobs[job.id] = job
+        excess = len(self.jobs) - self.finished_retention
+        if excess > 0:
+            # oldest-first (dict insertion order), stop as soon as the
+            # excess is covered — finished jobs cluster at the front,
+            # so this stays O(excess + live prefix), not O(table)
+            evict = []
+            for jid, j in self.jobs.items():
+                if len(evict) >= excess:
+                    break
+                if j.state in J.TERMINAL:
+                    evict.append(jid)
+            for jid in evict:
+                j = self.jobs.pop(jid)
+                if j.job_key is not None \
+                        and self._job_keys.get(j.job_key) == jid:
+                    del self._job_keys[j.job_key]
+            if evict:
+                self.metrics.inc("jobs_evicted", len(evict))
 
     def get_job(self, job_id):
         with self._jobs_lock:
@@ -150,11 +220,102 @@ class ProofService:
             out["aot"] = aot_warmup(backend, res.domain_size, ck=res.pk.ck)
         return out
 
+    # -- restart recovery -----------------------------------------------------
+
+    def _recover(self):
+        """Rebuild queue + job table from the replayed journal (runs in
+        start(), before the scheduler/listener). Non-terminal jobs are
+        re-enqueued under their ORIGINAL ids — their `ckpt:<id>` round
+        snapshots still match, so the prove resumes at the last journaled
+        round boundary with zero recompute. DONE jobs are restored from
+        their finished-proof artifacts (no re-prove; a lost artifact
+        degrades to a re-prove of the same deterministic bytes). SHED and
+        FAILED verdicts stay queryable."""
+        if self.journal is None:
+            return
+        recovered = finished = 0
+        for jid, st in list(self.journal.state.items()):
+            try:
+                spec = JobSpec.from_wire(st.get("spec"))
+            except (ValueError, TypeError):
+                # unparseable SUBMIT payload (foreign/ancient journal):
+                # skip the record, never refuse to start
+                continue
+            job = Job(spec, job_id=jid)
+            # the deadline is the ORIGINAL submission's, not re-derived
+            # from recovery time — a restart must not extend any TTL
+            job.deadline_ts = st.get("deadline")
+            phase = st["phase"]
+            if phase == "done" and self._restore_done(job, st):
+                finished += 1
+            elif phase == "shed":
+                job.finish_shed(st.get("reason") or "shed")
+            elif phase == "failed":
+                job.finish_err(st.get("reason") or "failed")
+            elif job.expired():
+                # deadline lapsed during the outage: verdict, not work.
+                # (JobJournal serializes internally; _recover runs before
+                # the scheduler/listener threads exist, so the submit
+                # lock is not needed here)
+                self.journal.append(JN.SHED, job.id,  # analysis: ok(journal has its own lock; single-threaded recovery)
+                                    reason="ttl expired during restart")
+                self.metrics.inc("jobs_shed")
+                job.finish_shed("ttl expired during restart")
+            else:
+                # queued or mid-prove at crash time (a DONE job whose
+                # artifact was lost also lands here): back in the queue,
+                # bypassing the depth cap — the PREVIOUS process already
+                # admitted it
+                self.queue.submit(job, force=True)
+                recovered += 1
+            # rejected submissions keep their queryable verdict but do
+            # NOT reclaim the job_key: the live path frees the key on
+            # rejection so a retry is a fresh admission attempt, and a
+            # restart must not change that (review finding)
+            rejected = (phase == "shed" and (st.get("reason") or "")
+                        .startswith(JN.REJECTED_PREFIX))
+            with self._jobs_lock:
+                if job.job_key is not None and not rejected:
+                    self._job_keys[job.job_key] = job.id
+                self._register_locked(job)
+        if recovered:
+            self.metrics.inc("jobs_recovered", recovered)
+        if finished:
+            self.metrics.inc("jobs_recovered_finished", finished)
+        self.metrics.gauge("queue_depth", self.queue.depth())
+        # replay + recovery is the natural compaction point: the rewritten
+        # log starts this process's epoch at its minimal size
+        self.journal.compact()
+
+    def _restore_done(self, job, st):
+        """Restore a finished job from its DONE record: proof bytes come
+        from the store artifact (or the record's inline fallback). False
+        means the artifact is gone (evicted/corrupt) — caller re-proves."""
+        rec = st.get("done") or {}
+        proof_bytes = pub = None
+        if rec.get("proof_hex"):
+            proof_bytes = bytes.fromhex(rec["proof_hex"])
+            pub = [int(x, 16) for x in rec.get("pub") or []]
+        elif self.store is not None and rec.get("store_key"):
+            from ..store import keycache as KC
+            hit = KC.load_proof(self.store, job.id)
+            if hit is not None:
+                proof_bytes, pub, _meta = hit
+                if not pub:
+                    pub = [int(x, 16) for x in rec.get("pub") or []]
+        if proof_bytes is None:
+            self.metrics.inc("proof_artifacts_lost")
+            return False
+        job.retries = int(rec.get("retries") or 0)
+        job.finish_ok(proof_bytes, pub, {})
+        return True
+
     # -- lifecycle ------------------------------------------------------------
 
     def start(self):
         """Start scheduler + listener threads; returns self. With port=0
         an ephemeral port is chosen and published as `self.port`."""
+        self._recover()
         self.scheduler.start()
         self._listener = native.Listener(self.host, self.port)
         if self.port == 0:
@@ -176,12 +337,58 @@ class ProofService:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def serve_forever(self):
-        self._stopped.wait()
+    def serve_forever(self, poll_s=0.5):
+        # bounded waits so the MAIN thread regularly re-enters the
+        # interpreter: POSIX signal handlers (scripts/serve.py's
+        # SIGTERM graceful drain) only run between bytecodes, and an
+        # unbounded Event.wait can starve them on some platforms
+        while not self._stopped.wait(poll_s):
+            pass
 
     def shutdown(self):
         self.scheduler.stop()
         self.pool.shutdown()
+        if self._listener is not None:
+            self._listener.close()
+        if self.journal is not None:
+            self.journal.close()
+        self._stopped.set()
+
+    def drain(self, timeout_s=30.0):
+        """Graceful drain (the SIGTERM path, scripts/serve.py): stop
+        admission immediately, let in-flight jobs finish until the
+        deadline, then force the stragglers to stop at their next round
+        boundary (snapshot durable, journal consistent), flush + close
+        the journal, and release serve_forever. Returns True iff nothing
+        needed the forced stop. Queued-but-unstarted jobs stay journaled
+        and resume on the next start — a drain defers work, it never
+        loses it."""
+        self.metrics.inc("drain_started")
+        deadline = time.monotonic() + timeout_s
+        self.queue.close()       # admission now rejects with "draining"
+        self.scheduler.stop()
+        clean = self.pool.drain(deadline)
+        self.metrics.inc("drain_clean" if clean else "drain_forced")
+        if self._listener is not None:
+            self._listener.close()
+        if self.journal is not None:
+            self.journal.close()
+        self._stopped.set()
+        return clean
+
+    def crash(self):
+        """In-process analog of SIGKILL (tests, bench restart canary):
+        seal the journal (nothing more reaches disk — exactly what a
+        dead process writes), stop admission, and abandon the worker
+        threads at their next round boundary WITHOUT any of shutdown's
+        bookkeeping (no checkpoint clears, no terminal records, no journal
+        flush). What the journal + store hold at this instant is what a
+        restarted service gets."""
+        if self.journal is not None:
+            self.journal.seal()
+        self.queue.close()
+        self.scheduler.crash()
+        self.pool.crash()
         if self._listener is not None:
             self._listener.close()
         self._stopped.set()
@@ -215,7 +422,7 @@ class ProofService:
             conn.send(protocol.OK)
         elif tag == protocol.SUBMIT:
             try:
-                job = self.submit_local(protocol.decode_json(payload))
+                job, deduped = self.submit_ex(protocol.decode_json(payload))
             except ValueError as e:
                 conn.send(protocol.ERR, protocol.encode_json(
                     {"reason": f"bad_spec: {e}"}))
@@ -229,6 +436,11 @@ class ProofService:
             conn.send(protocol.OK, protocol.encode_json(
                 {"job_id": job.id,
                  "shape_key": [str(p) for p in job.shape_key],
+                 # idempotency: a duplicate job_key lands on the ORIGINAL
+                 # job (possibly already done — across restarts too);
+                 # "state" lets the client skip straight to RESULT
+                 "dedup": deduped,
+                 "state": job.state,
                  "queue_depth": self.queue.depth()}))
         elif tag == protocol.STATUS:
             job = self._lookup(conn, payload)

@@ -50,6 +50,42 @@ def bucket_store_key(shape_key):
     return "bucket:" + json.dumps(shape_key, separators=(",", ":"))
 
 
+# -- finished-proof artifacts -------------------------------------------------
+# Completed proofs join the same content-addressed surface as keys and
+# checkpoints (ROADMAP direction 2): the service journal's DONE record
+# carries the digest returned by store_proof, a restarted service serves
+# the result without re-proving, and any peer can STORE_FETCH it
+# cross-host. The blob is the raw proof_io layout (already a canonical
+# fixed-size wire format — no extra framing needed).
+
+def proof_store_key(job_id):
+    """Service job id -> finished-proof manifest key."""
+    return f"proof:{job_id}"
+
+
+def store_proof(store, job_id, proof_bytes, public_input, spec_wire=None,
+                retries=0):
+    """Persist one finished proof; returns its content digest (journaled
+    in the DONE record)."""
+    meta = {"kind": "proof",
+            "public_input": [hex(x) for x in public_input],
+            "retries": retries}
+    if spec_wire is not None:
+        meta["spec"] = spec_wire
+    return store.put(proof_store_key(job_id), proof_bytes, meta=meta)
+
+
+def load_proof(store, job_id):
+    """-> (proof_bytes, public_input ints, meta) or None (evicted /
+    integrity failure — recovery degrades to a re-prove, never crashes)."""
+    hit = store.get_entry(proof_store_key(job_id))
+    if hit is None:
+        return None
+    blob, _digest, meta = hit
+    pub = [int(x, 16) for x in meta.get("public_input", [])]
+    return blob, pub, meta
+
+
 def _fr_bytes(x):
     assert 0 <= x < R_MOD
     return int(x).to_bytes(_FR, "little")

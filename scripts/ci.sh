@@ -18,14 +18,18 @@
 #                          protocol phase (byte-identical proofs), breaker
 #                          open/re-admission, cross-host store-fetch resume,
 #                          injection layer (~1-2 min, jax-free: python
-#                          backend worker subprocesses over real TCP)
+#                          backend worker subprocesses over real TCP), PLUS
+#                          the durable-service-plane suite: service killed
+#                          at every journal transition -> restart recovers
+#                          byte-identically, dedup across restart, torn
+#                          journal, TTL shed, SIGTERM drain
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
 fi
 if [ "$1" = "chaos" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_runtime_faults.py \
+    tests/test_runtime_faults.py tests/test_service_journal.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "fast" ]; then

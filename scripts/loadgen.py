@@ -6,6 +6,12 @@
     python scripts/loadgen.py --jobs 12 --no-kill
     python scripts/loadgen.py --kill-rate 0.5 --corrupt-rate 0.3 \
         --delay-ms 5 --store-dir /tmp/s                    # chaos soak
+    python scripts/loadgen.py --kill-service ROUND2        # restart soak:
+        # spawns scripts/serve.py as a real subprocess (journal + store),
+        # submits the job mix with idempotency keys, SIGKILLs the SERVICE
+        # at the given journal occurrence mid-prove, restarts it on the
+        # same dirs, and requires every job to finish with proof bytes
+        # BYTE-IDENTICAL to a local uninterrupted prove
 
 Default run: spins up an in-process ProofService (chaos mode, host oracle
 backend), then N submitter threads (default 8, mixed toy domain sizes
@@ -52,6 +58,126 @@ def _verify_result(header, blob, key_cache, lock):
     return verify(vk, pub, deserialize_proof(blob), rng=random.Random(1))
 
 
+def _proof_reference(spec, _pk_cache={}):
+    """Uninterrupted local prove of `spec` — the byte-identity oracle the
+    restart soak compares recovered service results against. Proving keys
+    are cached per SHAPE (the expensive part; the soak's job mix rotates
+    a handful of shapes over many seeds)."""
+    import random as _random
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+    from distributed_plonk_tpu.proof_io import serialize_proof
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.service.jobs import (JobSpec, build_circuit,
+                                                    build_bucket_keys,
+                                                    shape_key)
+    s = JobSpec.from_wire(spec)
+    key = shape_key(s)
+    if key not in _pk_cache:
+        _pk_cache[key] = build_bucket_keys(s)[1]
+    return serialize_proof(prove(_random.Random(s.seed), build_circuit(s),
+                                 _pk_cache[key], PythonBackend()))
+
+
+def run_kill_service_soak(args):
+    """--kill-service: the durable-service-plane acceptance soak. The
+    frontend is a REAL serve.py process killed with os._exit at an exact
+    journal occurrence (DPT_FAULTS journal plane), restarted on the same
+    journal/store dirs, and every job — queued, mid-prove, or finished at
+    kill time — must complete byte-identically with no proving repeated
+    past the last checkpoint."""
+    import subprocess
+    import tempfile
+    from distributed_plonk_tpu.service import ServiceClient
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    jdir = args.journal_dir or tempfile.mkdtemp(prefix="dpt-lg-journal-")
+    sdir = args.store_dir or tempfile.mkdtemp(prefix="dpt-lg-store-")
+    port = args.port
+
+    def spawn(faults=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("DPT_FAULTS", None)
+        if faults:
+            env["DPT_FAULTS"] = faults
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(here, "serve.py"),
+             "--port", str(port), "--workers", str(args.workers),
+             "--journal-dir", jdir, "--store-dir", sdir, "--chaos",
+             "--allow-remote-shutdown"],
+            stdout=subprocess.PIPE, env=env, text=True)
+        p.stdout.readline()  # the {"listening": ...} banner
+        return p
+
+    t0 = time.time()
+    summary = {"mode": "kill-service", "kill_at": args.kill_service,
+               "jobs": args.jobs, "journal_dir": jdir, "store_dir": sdir}
+    # arm the service kill at the Nth matching journal occurrence; the
+    # job mix below guarantees ROUND records exist before it fires
+    proc = spawn(faults=f"kill:at=journal:tag={args.kill_service}")
+    specs = []
+    for i in range(args.jobs):
+        spec = dict(_MIX[i % len(_MIX)])
+        spec.update(seed=1000 + i, priority=i % 3,
+                    job_key=f"soak-{args.chaos_seed}-{i}")
+        specs.append(spec)
+    job_ids = {}
+    try:
+        with ServiceClient("127.0.0.1", port) as c:
+            for i, spec in enumerate(specs):
+                job_ids[i] = c.submit(spec)["job_id"]
+    except Exception as e:
+        # the kill can land while we are still submitting (e.g. SUBMIT-
+        # tag rules): whatever was journaled must still recover below
+        summary["submit_interrupted"] = repr(e)
+    rc = proc.wait(timeout=args.timeout)
+    summary["service_killed_rc"] = rc
+
+    proc2 = spawn()
+    recovered = verified = 0
+    failures = []
+    try:
+        with ServiceClient("127.0.0.1", port) as c:
+            for i, spec in enumerate(specs):
+                # duplicate submit: dedups onto the recovered job (and
+                # re-registers any job whose SUBMIT the kill swallowed)
+                r = c.submit(spec)
+                if r.get("dedup"):
+                    recovered += 1
+                st = c.wait(r["job_id"], timeout_s=args.timeout)
+                if st["state"] != "done":
+                    failures.append({"index": i, "state": st["state"],
+                                     "error": st.get("error")})
+                    continue
+                _hdr, blob = c.result(r["job_id"])
+                if blob == _proof_reference(spec):
+                    verified += 1
+                else:
+                    failures.append({"index": i,
+                                     "error": "proof bytes diverged"})
+            metrics = c.metrics()
+            c.shutdown_server()
+        proc2.wait(timeout=30)
+    finally:
+        for p in (proc, proc2):
+            if p.poll() is None:
+                p.kill()
+    ctr = metrics["counters"]
+    ok = rc != 0 and verified == args.jobs and not failures
+    summary.update({
+        "ok": ok,
+        "wall_s": round(time.time() - t0, 3),
+        "verified_byte_identical": verified,
+        "dedup_recovered": recovered,
+        "failed": failures,
+        "recovery": {k: ctr.get(k, 0) for k in
+                     ("journal_replays", "jobs_recovered",
+                      "jobs_recovered_finished", "checkpoint_resumes",
+                      "dedup_hits", "jobs_shed")},
+    })
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default=None,
@@ -81,10 +207,20 @@ def main():
                          "injected at every round boundary")
     ap.add_argument("--chaos-seed", type=int, default=0xC4A05,
                     help="seed for rate-based chaos decisions")
+    ap.add_argument("--kill-service", default=None, metavar="LABEL",
+                    help="restart soak: spawn serve.py as a subprocess, "
+                         "SIGKILL it at this journal occurrence (SUBMIT, "
+                         "START, ROUND, ROUND2, DONE, ...), restart it on "
+                         "the same journal/store, and require every job "
+                         "byte-identical")
+    ap.add_argument("--journal-dir", default=None,
+                    help="journal dir for --kill-service (default: tmp)")
     ap.add_argument("--timeout", type=float, default=600)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.kill_service is not None:
+        return run_kill_service_soak(args)
     from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
     from distributed_plonk_tpu.service import ProofService, ServiceClient
 

@@ -3,21 +3,36 @@
 
     JAX_PLATFORMS=cpu python scripts/serve.py --port 9555 --workers 2 \
         [--queue-depth 64] [--max-batch 8] [--retries 2] [--timeout 300] \
-        [--chaos] [--verify]
+        [--journal-dir /var/dpt/journal] [--chaos] [--verify]
+
+--journal-dir enables the crash-safe job journal: every submitted job
+survives a crash or deploy restart (in-flight ones resume from their
+checkpoints, finished ones serve from proof artifacts). SIGTERM/SIGINT
+triggers a graceful drain — admission stops, in-flight jobs get up to
+DPT_DRAIN_TIMEOUT_S (default 30) to finish, stragglers checkpoint and
+park, the journal flushes, and the process exits 0; a later start on the
+same --journal-dir picks every deferred job back up.
 
 --chaos enables the KILL_WORKER fault-injection tag (scripts/loadgen.py
---kill uses it); never enable it on a service you care about. --verify
-makes workers verify each proof server-side before marking it done.
+--kill uses it) and arms DPT_FAULTS-spec'd rules — including
+journal-plane service kills (`DPT_FAULTS="kill:at=journal:tag=ROUND2"`
+makes THIS PROCESS os._exit at exactly that journal occurrence; the
+restart-recovery tests and loadgen --kill-service drive it). Never
+enable it on a service you care about. --verify makes workers verify
+each proof server-side before marking it done.
 Prints one JSON line with the bound address once listening; SHUTDOWN tag
-or Ctrl-C stops it.
+stops it.
 """
 
 import argparse
 import json
 import os
+import signal
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DRAIN_TIMEOUT_S = float(os.environ.get("DPT_DRAIN_TIMEOUT_S", "30"))
 
 
 def parse_peers(arg):
@@ -34,6 +49,28 @@ def parse_peers(arg):
     return peers
 
 
+def validate_journal_dir(arg):
+    """Fail fast, at flag-parse time, with a message that names the flag:
+    a journal dir that can't actually take fsync'd appends must stop the
+    daemon BEFORE it accepts jobs it cannot make durable (discovering it
+    on the first SUBMIT would lose that job's durability silently)."""
+    path = os.path.abspath(os.path.expanduser(arg))
+    if os.path.exists(path) and not os.path.isdir(path):
+        raise SystemExit(f"--journal-dir: {path!r} exists and is not a "
+                         "directory")
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".probe.%d" % os.getpid())
+        with open(probe, "wb") as f:
+            f.write(b"x")
+            os.fsync(f.fileno())
+        os.remove(probe)
+    except OSError as e:
+        raise SystemExit(f"--journal-dir: {path!r} is not writable "
+                         f"({e.strerror or e})")
+    return path
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default="127.0.0.1")
@@ -45,6 +82,11 @@ def main():
     ap.add_argument("--timeout", type=float, default=None,
                     help="per-job wall-clock budget, seconds")
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--journal-dir", default=None,
+                    help="crash-safe job journal: jobs survive service "
+                         "restarts (resume from checkpoints, finished "
+                         "proofs served from artifacts); also the "
+                         "SIGTERM graceful-drain surface")
     ap.add_argument("--store-dir", default=None,
                     help="artifact store root: persists SRS/keys across "
                          "restarts and parks the JAX compile cache; warm "
@@ -65,6 +107,10 @@ def main():
                     help="let any client's SHUTDOWN frame stop the daemon")
     args = ap.parse_args()
 
+    journal_dir = None
+    if args.journal_dir is not None:
+        journal_dir = validate_journal_dir(args.journal_dir)
+
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.store_dir is not None:
         # park the persistent compile cache under the store root BEFORE
@@ -72,7 +118,16 @@ def main():
         # alongside the keys they serve
         from distributed_plonk_tpu.store import set_jax_cache_env
         set_jax_cache_env(args.store_dir)
+    from distributed_plonk_tpu.runtime.faults import FaultInjector
     from distributed_plonk_tpu.service import ProofService
+
+    faults = None
+    if args.chaos:
+        # journal-plane kills die for real: os._exit skips every atexit/
+        # finally (the whole point — a crash leaves no goodbye), so the
+        # restarted process sees exactly what a power cut would leave
+        faults = FaultInjector.from_env(
+            kill_cb=lambda _label: os._exit(1))
 
     svc = ProofService(
         host=args.host, port=args.port, prover_workers=args.workers,
@@ -82,17 +137,36 @@ def main():
         verify_on_complete=args.verify,
         allow_remote_shutdown=args.allow_remote_shutdown,
         store_dir=args.store_dir, store_byte_budget=args.store_budget,
-        bucket_cap=args.bucket_cap,
+        bucket_cap=args.bucket_cap, journal_dir=journal_dir,
+        faults=faults,
         store_peers=parse_peers(args.store_peers)
         if args.store_peers else None).start()
+
+    drain_state = {}
+
+    def _drain_handler(signum, _frame):
+        # signal handlers run on the main thread while serve_forever
+        # blocks in Event.wait — drain() releases that wait when done
+        if drain_state:
+            return  # second signal during a drain: already on our way out
+        drain_state["signal"] = signal.Signals(signum).name
+        drain_state["clean"] = svc.drain(timeout_s=DRAIN_TIMEOUT_S)
+
+    signal.signal(signal.SIGTERM, _drain_handler)
+    signal.signal(signal.SIGINT, _drain_handler)
+
     print(json.dumps({"listening": f"{svc.host}:{svc.port}",
                       "workers": args.workers, "chaos": args.chaos,
-                      "store": args.store_dir}),
+                      "store": args.store_dir, "journal": journal_dir}),
           flush=True)
-    try:
-        svc.serve_forever()
-    except KeyboardInterrupt:
-        svc.shutdown()
+    svc.serve_forever()
+    if drain_state:
+        ctr = svc.metrics.snapshot()["counters"]
+        print(json.dumps({"drained": drain_state.get("signal"),
+                          "clean": drain_state.get("clean"),
+                          "jobs_drain_parked":
+                              ctr.get("jobs_drain_parked", 0)}),
+              flush=True)
 
 
 if __name__ == "__main__":
