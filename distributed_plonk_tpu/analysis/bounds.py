@@ -102,11 +102,12 @@ class AbsVal:
     """
 
     __slots__ = ("dtype", "shape", "lo", "hi", "exact",
-                 "bcast_axes", "iota_axis", "onehot_axes")
+                 "bcast_axes", "iota_axis", "onehot_axes", "pow2",
+                 "anchor", "anchor_kind")
 
     def __init__(self, dtype, shape, lo, hi, exact=True,
                  bcast_axes=frozenset(), iota_axis=None,
-                 onehot_axes=frozenset()):
+                 onehot_axes=frozenset(), pow2=0):
         self.dtype = np.dtype(dtype)
         self.shape = tuple(shape)
         self.lo = lo
@@ -115,6 +116,18 @@ class AbsVal:
         self.bcast_axes = frozenset(bcast_axes)
         self.iota_axis = iota_axis
         self.onehot_axes = frozenset(onehot_axes)
+        # pow2 < 0: the value is m * 2^pow2 with m an exactly-represented
+        # f32 integer — an exponent-only rescale of an exact value (the
+        # lazy-carry kernels' cols * 2^-8). anchor/anchor_kind track the
+        # x -> x*2^-k -> floor -> *2^k -> x - that remainder chain (the
+        # lazy local rounds' base-2^k digit split, which plain interval
+        # arithmetic cannot bound below 2^k): "scaled" = x * 2^-k,
+        # "floordiv" = floor(x * 2^-k), "floormul" = floor(x * 2^-k)*2^k,
+        # each anchored to id(x). Every rule that constructs a fresh
+        # AbsVal drops the tags (conservative, sound).
+        self.pow2 = pow2
+        self.anchor = None
+        self.anchor_kind = None
 
     @property
     def zero(self):
@@ -229,7 +242,17 @@ def _join(a, b):
                   bcast_axes=a.bcast_axes & b.bcast_axes,
                   iota_axis=a.iota_axis if a.iota_axis == b.iota_axis
                   else None,
-                  onehot_axes=a.onehot_axes & b.onehot_axes)
+                  onehot_axes=a.onehot_axes & b.onehot_axes,
+                  pow2=a.pow2 if a.pow2 == b.pow2 else 0)
+
+
+def _pow2_exponent(v):
+    """k if v is a single-valued positive power-of-two constant 2^k,
+    else None (the exact-rescale side condition of the mul rule)."""
+    if v.lo != v.hi or not v.lo > 0:
+        return None
+    m, e = math.frexp(float(v.lo))
+    return e - 1 if m == 0.5 else None
 
 
 def _stable(prev, new):
@@ -437,12 +460,46 @@ class Interpreter:
 
     def _p_sub(self, eqn, ins):
         a, b = ins
+        if (b.anchor_kind == "floormul" and b.anchor == id(a)
+                and a.lo >= 0 and a.exact):
+            # x - floor(x * 2^-k) * 2^k for x >= 0: the base-2^k
+            # remainder, in [0, 2^k) (the lazy-carry local rounds'
+            # digit split; every op in the chain was proved exact)
+            return self._arith_result(eqn, 0, (1 << (-b.pow2)) - 1)
         return self._arith_result(eqn, a.lo - b.hi, a.hi - b.lo,
                                   a.exact and b.exact)
 
     def _p_mul(self, eqn, ins):
         a, b = ins
         prods = [a.lo * b.lo, a.lo * b.hi, a.hi * b.lo, a.hi * b.hi]
+        d = np.dtype(self._out(eqn)[0])
+        if d.kind == "f":
+            for x, y in ((a, b), (b, a)):
+                k = _pow2_exponent(y)
+                if k is None:
+                    continue
+                if (k < 0 and x.exact and x.pow2 == 0 and x.lo >= 0
+                        and np.dtype(x.dtype).kind == "f"):
+                    # exact power-of-two down-scaling (the lazy-carry
+                    # local rounds' cols * 2^-8): exponent-only, the
+                    # mantissa — already proved f32-exact via x.exact —
+                    # is untouched, so the value is exactly m * 2^k even
+                    # though no longer integer-valued. Tag for the floor
+                    # rule instead of flagging here.
+                    out = AbsVal(d, self._out(eqn)[1], min(prods),
+                                 max(prods), exact=False, pow2=k)
+                    out.anchor = id(x)
+                    out.anchor_kind = "scaled"
+                    return out
+                if (k > 0 and x.anchor_kind == "floordiv"
+                        and x.pow2 == -k and x.exact):
+                    # floor(x * 2^-k) * 2^k: restore the anchor so the
+                    # subtraction rule can recognize the remainder
+                    out = self._arith_result(eqn, min(prods), max(prods))
+                    out.anchor = x.anchor
+                    out.anchor_kind = "floormul"
+                    out.pow2 = x.pow2
+                    return out
         return self._arith_result(eqn, min(prods), max(prods),
                                   a.exact and b.exact)
 
@@ -473,6 +530,28 @@ class Interpreter:
 
     def _p_sign(self, eqn, ins):
         return self._mk(eqn, -1, 1)
+
+    def _p_floor(self, eqn, ins):
+        # floor of an exact value (or of a pow2-tagged exact rescale) is
+        # an exact integer; _arith_result re-checks the f32 magnitude
+        # bound. floor of anything else is integer-valued but its
+        # pre-round error is unknowable — flag like any inexact float.
+        # Used by field_pallas' lazy-carry local rounds.
+        (a,) = ins
+        lo, hi = int(math.floor(a.lo)), int(math.floor(a.hi))
+        out = self._arith_result(eqn, lo, hi,
+                                 exact_in=a.exact or a.pow2 < 0)
+        if a.anchor_kind == "scaled" and a.pow2 < 0:
+            out.anchor = a.anchor
+            out.anchor_kind = "floordiv"
+            out.pow2 = a.pow2
+        return out
+
+    def _p_round(self, eqn, ins):
+        (a,) = ins
+        return self._arith_result(eqn, int(math.floor(a.lo)),
+                                  int(math.ceil(a.hi)),
+                                  exact_in=a.exact or a.pow2 < 0)
 
     def _p_integer_pow(self, eqn, ins):
         (a,) = ins

@@ -101,6 +101,33 @@ def _field_entries():
     return out
 
 
+def _field_pallas_entries():
+    """The standalone fused-multiplier Pallas kernels (DPT_FIELD_MUL=
+    pallas): lazy-carry VPU (the round-5 default) and MXU-Toeplitz
+    variants, both fields, at the kernel's real lane tile. These were
+    parity-tested only (tests/test_field_pallas.py) while the bounds
+    pass couldn't see inside pallas_call; now their kernel jaxprs are
+    proof obligations like the fused MSM/NTT kernels — closing the
+    carried-forward Pallas obligation from PR 5 (strict-mul bodies were
+    proved there via the MSM kernel; these are the remaining entry
+    points, incl. the lazy local-round / bf16 band paths the MSM kernel
+    does not embed)."""
+    from ..backend import field_jax as FJ
+    from ..backend import field_pallas as FP
+
+    out = []
+    for spec in (FJ.FR, FJ.FQ):
+        L = spec.n_limbs
+        pair = (limb_rows(L, FP.LANE_TILE), limb_rows(L, FP.LANE_TILE))
+        n = spec.name.lower()
+        for variant in ("lazy", "mxu"):
+            out.append(Entry(
+                f"field/{n}_mont_mul_pallas_{variant}",
+                lambda a, b, s=spec: FP.mont_mul(s, a, b), pair,
+                [(0, U16)], patches=[(FP, "_VARIANT", variant)]))
+    return out
+
+
 def _ntt_entries():
     from ..backend import ntt_jax as NTT
 
@@ -113,8 +140,13 @@ def _ntt_entries():
         for inverse in (False, True):
             for coset in (False, True):
                 for boundary in ("mont", "plain"):
+                    # kernel pinned to the XLA core: these entries prove
+                    # the radix-4 stage pipeline regardless of what
+                    # DPT_NTT_KERNEL resolves to in the checking env
+                    # (the pallas program has its own entries below)
                     fn, consts = plan.traced_kernel(
-                        inverse, coset, boundary=boundary, radix=4)
+                        inverse, coset, boundary=boundary, radix=4,
+                        kernel="xla")
                     cnp = {k: np.asarray(v) for k, v in consts.items()}
                     out.append(Entry(
                         f"ntt/n{n}_radix4_inv{int(inverse)}"
@@ -124,15 +156,46 @@ def _ntt_entries():
         # stage body is mode-independent modulo pre/post table muls,
         # which the inverse+coset variant includes)
         fn, consts = plan.traced_kernel(True, True, boundary="mont",
-                                        radix=2)
+                                        radix=2, kernel="xla")
         cnp = {k: np.asarray(v) for k, v in consts.items()}
         out.append(Entry(f"ntt/n{n}_radix2_inv1_coset1_mont", fn,
                          (limb_rows(16, n), cnp), [(0, U16)]))
         # batched kernel (the prover's round-1/round-3 launches)
-        fn, consts = plan.traced_kernel(False, True, radix=4, batch=True)
+        fn, consts = plan.traced_kernel(False, True, radix=4, batch=True,
+                                        kernel="xla")
         cnp = {k: np.asarray(v) for k, v in consts.items()}
         out.append(Entry(f"ntt/n{n}_radix4_batch3_coset", fn,
                          (limb_rows(16, 3, n), cnp), [(0, U16)]))
+    # fused multi-stage Pallas kernel (DPT_NTT_KERNEL=pallas): the
+    # pallas_call kernel jaxprs are interpreted like the fused MSM's
+    # (bounds._p_pallas_call). Coverage: forward+coset (pre-scale fused
+    # into the first group) and inverse+coset (reordered post-scales in
+    # the last group) at odd/even log2(n); a small-rows schedule forces
+    # TWO sequential fused groups in one program (narrow VMEM budget);
+    # batch width > 1 checks the (B, tiles) grid. Fresh NttPlan
+    # instances, NOT get_plan: the forced schedules must not poison the
+    # shared plan's consts memo.
+    from ..backend import ntt_pallas as NP
+
+    def pallas_ntt(n, inverse, coset, batch, rows_cap):
+        saved = NP._ROWS_CAP
+        NP._ROWS_CAP = rows_cap
+        try:
+            plan = NTT.NttPlan(n)
+            fn, consts = plan.traced_kernel(inverse, coset, radix=4,
+                                            batch=batch, kernel="pallas")
+        finally:
+            NP._ROWS_CAP = saved
+        cnp = {k: np.asarray(v) for k, v in consts.items()}
+        shape = (16, 3, n) if batch else (16, n)
+        return Entry(
+            f"ntt/n{n}_pallas_inv{int(inverse)}_coset{int(coset)}"
+            + ("_batch3" if batch else "") + f"_rows{rows_cap}",
+            fn, (limb_rows(*shape), cnp), [(0, U16)])
+
+    out.append(pallas_ntt(64, False, True, False, 64))   # one group, R=6
+    out.append(pallas_ntt(64, True, True, False, 8))     # two groups, R=3
+    out.append(pallas_ntt(32, False, False, True, 32))   # odd log2, batch
     return out
 
 
@@ -236,14 +299,23 @@ def _msm_entries():
 
 def _curve_entries():
     from ..backend import curve_jax as CJ
+    from ..backend import curve_pallas as CP
 
     pt = lambda: tuple(limb_rows(24, 8) for _ in range(3))
     coords_out = [(0, U16)] * 3
+    # the standalone curve_pallas FULL-add kernel at its real lane tile:
+    # the mixed-add body is proved through the fused MSM kernel (PR 5),
+    # the full add (RCB15 algorithm 7 — cross-chunk folds, finish tail
+    # doubling ladder on TPU) was parity-tested only. Closes the last
+    # curve piece of the carried-forward Pallas proof obligation.
+    ptp = lambda: tuple(limb_rows(24, CP.LANE_TILE) for _ in range(3))
     return [
         Entry("curve/proj_add", CJ.proj_add, (pt(), pt()), coords_out),
         Entry("curve/proj_add_mixed", CJ.proj_add_mixed,
               (pt(), (limb_rows(24, 8), limb_rows(24, 8)),
                Bound((8,), jnp.bool_, 0, 1)), coords_out),
+        Entry("curve/proj_add_pallas_full", CP.proj_add, (ptp(), ptp()),
+              coords_out),
         Entry("curve/jac_add", CJ.jac_add, (pt(), pt()), coords_out),
         Entry("curve/jac_double", CJ.jac_double, (pt(),), coords_out),
     ]
@@ -251,8 +323,8 @@ def _curve_entries():
 
 def build_registry():
     """All production entries (list of Entry)."""
-    return (_field_entries() + _ntt_entries() + _msm_entries()
-            + _curve_entries())
+    return (_field_entries() + _field_pallas_entries() + _ntt_entries()
+            + _msm_entries() + _curve_entries())
 
 
 def run_bounds(strict=True, names=None, progress=None, contracts=True):

@@ -248,19 +248,26 @@ def _add_full_kernel(x1_ref, y1_ref, z1_ref, x2_ref, y2_ref, z2_ref,
     oz_ref[...] = z3.astype(jnp.uint32)
 
 
-def fq_consts():
-    """Hashable Fq constant tuple for kernels embedding these primitives
-    (jit-static; feed through consts_env inside the kernel body)."""
-    from .field_jax import FQ
-
-    L = FQ.n_limbs
+def field_consts(spec):
+    """Hashable per-field constant tuple for kernels embedding these
+    primitives (jit-static; feed through consts_env inside the kernel
+    body). Width-generic: Fq for the curve/MSM kernels, Fr for the fused
+    NTT stage kernel (ntt_pallas)."""
+    L = spec.n_limbs
     return (("n_limbs", L),
             ("ninv_bytes",
-             tuple(_const_bytes(int_from_limbs(FQ.ninv_limbs), 2 * L))),
+             tuple(_const_bytes(int_from_limbs(spec.ninv_limbs), 2 * L))),
             ("mod_bytes",
-             tuple(_const_bytes(int_from_limbs(FQ.mod_limbs), 2 * L))),
-            ("negmod_limbs", tuple(int(v) for v in FQ.negmod_limbs)),
-            ("mod_limbs", tuple(int(v) for v in FQ.mod_limbs)))
+             tuple(_const_bytes(int_from_limbs(spec.mod_limbs), 2 * L))),
+            ("negmod_limbs", tuple(int(v) for v in spec.negmod_limbs)),
+            ("mod_limbs", tuple(int(v) for v in spec.mod_limbs)))
+
+
+def fq_consts():
+    """field_consts(Fq) — the constant set of the curve/MSM kernels."""
+    from .field_jax import FQ
+
+    return field_consts(FQ)
 
 
 _fq_consts = fq_consts  # internal spelling kept for the add kernels below

@@ -89,13 +89,15 @@ def _carry_sweep_val(cols, n_limbs):
 
 
 def _to_bytes_f32(limbs):
-    """(L, T) i32 16-bit limbs -> (2L, T) f32 byte rows (little-endian:
-    row 2k = limb k low byte, row 2k+1 = high byte)."""
-    L, T = limbs.shape
+    """(L, *t) i32 16-bit limbs -> (2L, *t) f32 byte rows (little-endian:
+    row 2k = limb k low byte, row 2k+1 = high byte). Trailing-dims
+    generic: the fused NTT kernel runs it on (L, rows, T) blocks, the 2D
+    callers are unchanged."""
+    L = limbs.shape[0]
     ev = (limbs & 0xFF).astype(jnp.float32)
     od = jnp.right_shift(limbs, 8).astype(jnp.float32)
     # interleave via stack + reshape on the major axis
-    return jnp.stack([ev, od], axis=1).reshape(2 * L, T)
+    return jnp.stack([ev, od], axis=1).reshape((2 * L,) + limbs.shape[1:])
 
 
 def _band_mul(t_ref, a_bytes, b_bytes):
@@ -125,10 +127,11 @@ def _band_mul_const(t_ref, c_bytes, b_bytes):
 
 
 def _cols_to_limbs(cols_f32):
-    """(2K, T) f32 byte columns -> (K, T) i32 combined limb columns
-    (ev + od*256, any u32 — fed to the carry sweep)."""
-    twoK, T = cols_f32.shape
-    v = cols_f32.reshape(twoK // 2, 2, T)
+    """(2K, *t) f32 byte columns -> (K, *t) i32 combined limb columns
+    (ev + od*256, any u32 — fed to the carry sweep). Trailing-dims
+    generic like _to_bytes_f32."""
+    twoK = cols_f32.shape[0]
+    v = cols_f32.reshape((twoK // 2, 2) + cols_f32.shape[1:])
     ev = v[:, 0].astype(jnp.int32)
     od = v[:, 1].astype(jnp.int32)
     return ev + jnp.left_shift(od, 8)

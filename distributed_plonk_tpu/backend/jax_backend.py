@@ -32,6 +32,14 @@ from .field_jax import FR
 from .msm_jax import MsmContext
 from .limbs import ints_to_limbs
 
+# Round-3 pointwise fusion (DPT_R3_FUSE, default on): fold the gate /
+# sigma quotient products into the selector/sigma coset-FFT programs as
+# epilogues, and the final quotient combine into the coset iNTT as a
+# prologue (NttPlan.kernel_fused) — the quotient pipeline loses its
+# standalone O(m) passes. 0 restores the separate jitted step programs
+# (the value-identical reference path, kept like DPT_NTT_KERNEL=xla).
+_R3_FUSE = os.environ.get("DPT_R3_FUSE", "1") != "0"
+
 
 class JaxBackend:
     """Backend over single-device jitted kernels.
@@ -199,9 +207,8 @@ class JaxBackend:
         from ..poly import Domain
         report = {"ntt": {}}
         quot = Domain((NUM_WIRE_TYPES + 1) * (domain_size + 1) + 1)
-        elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
         for dom_n in sorted({domain_size, quot.size}):
-            chunk = max(1, min(self._NTT_BATCH, elems_cap // dom_n))
+            chunk = self._ntt_chunk(dom_n)
             report["ntt"][dom_n] = ntt_jax.get_plan(dom_n).aot_compile(
                 batch_sizes=(chunk,) if chunk > 1 else ())
         if ck is not None:
@@ -252,14 +259,21 @@ class JaxBackend:
         return (jnp.pad(h, ((0, 0), (0, size - h.shape[1])))
                 if h.shape[1] < size else h)
 
+    def _ntt_chunk(self, domain_size):
+        """Batch width of one NTT launch: B*n capped by the mul-path
+        transient budget (the ONE copy of the cap heuristic —
+        _kernel_batches, the fused round-3 launches, and AOT warmup all
+        pick their widths here so they can never desync)."""
+        elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
+        return max(1, min(self._NTT_BATCH, elems_cap // domain_size))
+
     def _kernel_batches(self, domain, hs, inverse, coset):
         """Yield (16, B, m) NTT result batches covering hs in order, B
-        capped by the launch budget. The ONE copy of the cap/chunk/pad
-        logic — _kernel_many collects, quotient_streamed folds each batch
-        into accumulators so no batch outlives its consumption."""
+        capped by the launch budget (_ntt_chunk). _kernel_many collects,
+        quotient_streamed folds each batch into accumulators so no batch
+        outlives its consumption."""
         plan = ntt_jax.get_plan(domain.size)
-        elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
-        chunk = max(1, min(self._NTT_BATCH, elems_cap // domain.size))
+        chunk = self._ntt_chunk(domain.size)
         if chunk == 1:
             fn1 = plan.kernel(inverse=inverse, coset=coset, boundary="mont")
             for h in hs:
@@ -329,13 +343,59 @@ class JaxBackend:
                 self._domain_tabs_packed[key] = hit
         return hit
 
-    def quotient_streamed(self, n, m, quot_domain, k, beta, gamma, alpha,
-                          alpha_sq_div_n, sel_h, sigma_h, wire_polys,
-                          perm_poly, pi_coeffs):
-        """Round 3 from coefficient handles: coset FFTs + quotient
-        evaluation in one streaming pass (see class comment). Returns
-        unpacked (16, m) quotient evals for the coset iFFT."""
-        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
+    # selector index -> (UNJITTED step body, wire-plane operand indices);
+    # the round-3 FUSED path (DPT_R3_FUSE) traces these as the epilogue
+    # of the selector coset-FFT program itself, so XLA fuses the gate
+    # product with the NTT's final stage / output permutation and the
+    # (16, B, m) selector planes never round-trip HBM between the FFT
+    # and their one consuming multiply. Same circuit.py order as the
+    # jitted gate_steps table below.
+    _R3_GATE_STEPS = (
+        [(PJ.gate_linear_step, (i,)) for i in range(4)]             # Q_LC
+        + [(PJ.gate_mul2_step, (0, 1)), (PJ.gate_mul2_step, (2, 3))]  # Q_MUL
+        + [(PJ.gate_pow5_step, (i,)) for i in range(4)]             # Q_HASH
+        + [(PJ.gate_out_step, (4,)),                                # Q_O
+           (PJ.gate_const_step, ()),                                # Q_C
+           (PJ.gate_ecc_step, (0, 1, 2, 3, 4))]                     # Q_ECC
+    )
+
+    @classmethod
+    def _gate_epilogue(cls, start, width):
+        steps = cls._R3_GATE_STEPS[start:start + width]
+
+        def epi(res, gate_p, *wires):
+            for j, (fn, widx) in enumerate(steps):
+                gate_p = fn(gate_p, res[:, j], *[wires[x] for x in widx])
+            return gate_p
+        return epi
+
+    @staticmethod
+    def _sigma_epilogue(start, width):
+        def epi(res, acc2_p, beta_c, gamma_c, *wires):
+            for j in range(width):
+                acc2_p = PJ.sigma_step(acc2_p, res[:, j], wires[start + j],
+                                       beta_c, gamma_c)
+            return acc2_p
+        return epi
+
+    @staticmethod
+    def _combine_prologue(m):
+        def pro(w0, w1, w2, w3, w4, z_p, gate_p, acc2_p, ep, zh, sh,
+                k_arr, beta, gamma, alpha, asdn):
+            ev = PJ.quotient_combine_slice(
+                [w0, w1, w2, w3, w4], z_p, gate_p, acc2_p, ep, zh, sh,
+                k_arr, beta, gamma, alpha, asdn, jnp.uint32(0), chunk=m)
+            return ev[:, None, :]
+        return pro
+
+    def _r3_accumulate(self, n, m, quot_domain, beta, gamma, sel_h, sigma_h,
+                       wire_polys, perm_poly, pi_coeffs):
+        """Shared front half of round 3: base coset FFTs + gate/sigma
+        plane folding. Returns (wires_p, z_p, gate_p, acc2_p, throttle).
+        Under DPT_R3_FUSE each selector/sigma batch's fold runs as the
+        EPILOGUE of its own coset-FFT program (NttPlan.kernel_fused) —
+        value-identical to the standalone jitted steps, minus their
+        write-plane + read-plane HBM pass per batch."""
         ratio = m // n
         base = self.coset_fft_many_packed(
             quot_domain, list(wire_polys) + [perm_poly, pi_coeffs])
@@ -347,12 +407,11 @@ class JaxBackend:
 
         sync_every = (self._STREAM_SYNC_EVERY
                       if m >= self._STREAM_SYNC_MIN_M else 0)
-        launches = 0
+        launches = [0]
 
         def _throttle(h):
-            nonlocal launches
-            launches += 1
-            if sync_every and launches % sync_every == 0:
+            launches[0] += 1
+            if sync_every and launches[0] % sync_every == 0:
                 # 1-element fetch: bounds the async queue. Counted in
                 # `drains`, NOT `lowers` — the lowers counter audits
                 # PROTOCOL transfers (transcript scalars); this is a
@@ -365,8 +424,31 @@ class JaxBackend:
         beta_c = jnp.asarray(PJ.lift_scalar(beta))
         gamma_c = jnp.asarray(PJ.lift_scalar(gamma))
         w = wires_p
-        # selector index -> (structural step program, wire-plane operands);
-        # 13 selectors share 6 compiled programs (circuit.py order)
+        if _R3_FUSE:
+            plan = ntt_jax.get_plan(quot_domain.size)
+            chunk = self._ntt_chunk(quot_domain.size)
+            for i in range(0, len(sel_h), chunk):
+                hs = [self._pad_to(h, quot_domain.size)
+                      for h in sel_h[i:i + chunk]]
+                fnk = plan.kernel_fused(
+                    False, True, key=("r3gate", i, len(hs)),
+                    epilogue=self._gate_epilogue(i, len(hs)))
+                gate_p = fnk((jnp.stack(hs, axis=1),),
+                             (gate_p,) + tuple(w))
+                _throttle(gate_p)
+            for i in range(0, len(sigma_h), chunk):
+                hs = [self._pad_to(h, quot_domain.size)
+                      for h in sigma_h[i:i + chunk]]
+                fnk = plan.kernel_fused(
+                    False, True, key=("r3sigma", i, len(hs)),
+                    epilogue=self._sigma_epilogue(i, len(hs)))
+                acc2_p = fnk((jnp.stack(hs, axis=1),),
+                             (acc2_p, beta_c, gamma_c) + tuple(w))
+                _throttle(acc2_p)
+            return wires_p, z_p, gate_p, acc2_p, _throttle
+
+        # unfused reference path: standalone jitted step programs
+        # (13 selectors share 6 compiled programs, circuit.py order)
         gate_steps = (
             [(PJ.gate_linear_step_jit, (w[i],)) for i in range(4)]      # Q_LC
             + [(PJ.gate_mul2_step_jit, (w[0], w[1])),                   # Q_MUL
@@ -390,6 +472,20 @@ class JaxBackend:
                                            beta_c, gamma_c)
                 sj += 1
             _throttle(acc2_p)
+        return wires_p, z_p, gate_p, acc2_p, _throttle
+
+    def quotient_streamed(self, n, m, quot_domain, k, beta, gamma, alpha,
+                          alpha_sq_div_n, sel_h, sigma_h, wire_polys,
+                          perm_poly, pi_coeffs):
+        """Round 3 from coefficient handles: coset FFTs + quotient
+        evaluation in one streaming pass (see class comment). Returns
+        unpacked (16, m) quotient evals for the coset iFFT (the sliced
+        combine; `quotient_poly_streamed` is the fused path that skips
+        this materialization entirely)."""
+        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
+        wires_p, z_p, gate_p, acc2_p, _throttle = self._r3_accumulate(
+            n, m, quot_domain, beta, gamma, sel_h, sigma_h, wire_polys,
+            perm_poly, pi_coeffs)
 
         chunk = min(self._QUOT_SLICE, m)
         assert m % chunk == 0
@@ -404,6 +500,37 @@ class JaxBackend:
                 k_arr, *scal, np.uint32(j0), chunk=chunk))
             _throttle(outs[-1])
         return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+    def quotient_poly_streamed(self, n, m, quot_domain, k, beta, gamma,
+                               alpha, alpha_sq_div_n, sel_h, sigma_h,
+                               wire_polys, perm_poly, pi_coeffs):
+        """Round 3 all the way to the quotient POLYNOMIAL: the streaming
+        accumulation, then — under DPT_R3_FUSE (default on) — the final
+        pointwise combine runs as the PROLOGUE of the coset iNTT program
+        (NttPlan.kernel_fused), fusing into the first inverse stage's
+        reads so the (16, m) quotient-eval array never materializes as a
+        standalone pass. With the knob off this is exactly
+        quotient_streamed + coset_ifft_h (the sliced reference path)."""
+        if not _R3_FUSE:
+            evals = self.quotient_streamed(
+                n, m, quot_domain, k, beta, gamma, alpha, alpha_sq_div_n,
+                sel_h, sigma_h, wire_polys, perm_poly, pi_coeffs)
+            return self.coset_ifft_h(quot_domain, evals)
+        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
+        wires_p, z_p, gate_p, acc2_p, _throttle = self._r3_accumulate(
+            n, m, quot_domain, beta, gamma, sel_h, sigma_h, wire_polys,
+            perm_poly, pi_coeffs)
+        k_arr = jnp.asarray(PJ.lift(list(k))).reshape(FR_LIMBS, len(k), 1)
+        scal = [jnp.asarray(PJ.lift_scalar(x))
+                for x in (beta, gamma, alpha, alpha_sq_div_n)]
+        plan = ntt_jax.get_plan(quot_domain.size)
+        fnk = plan.kernel_fused(True, True, key=("r3combine",),
+                                prologue=self._combine_prologue(m))
+        poly = fnk(tuple(wires_p) + (z_p, gate_p, acc2_p, tabs["ep"],
+                                     tabs["zh_inv"], tabs["shifted_inv"],
+                                     k_arr) + tuple(scal))[:, 0]
+        _throttle(poly)
+        return poly
 
     def coset_fft_h(self, domain, h):
         return self._kernel(domain, h, False, True)

@@ -72,6 +72,46 @@ def _active_radix(radix=None):
     return radix
 
 
+# Stage-core kernel (DPT_NTT_KERNEL), mirroring DPT_MSM_KERNEL:
+#   pallas: the fused multi-stage VMEM-resident kernel (ntt_pallas) —
+#     log2(rows) butterfly stages per HBM round trip instead of the
+#     radix-4 scan's two; coset pre-scale and inverse post-scales fused
+#     into the first/last group.
+#   xla: the radix-4/radix-2 lax.scan cores (the parity/debug reference,
+#     exactly like DPT_MSM_KERNEL=xla keeps the bucket scan).
+#   auto (default): pallas on TPU, xla elsewhere (CPU interpret-mode
+#     pallas is test-only).
+# field_jax.pallas_disabled() / mesh.pallas_guard override even a forced
+# "pallas" — a pallas_call has no GSPMD partitioning rule, so sharded
+# operands outside shard_map must never meet one.
+_NTT_KERNEL = os.environ.get("DPT_NTT_KERNEL", "auto")
+
+
+def _use_pallas_kernel():
+    if getattr(FJ._pallas_off, "v", False):
+        return False
+    if _NTT_KERNEL in ("pallas", "xla"):
+        return _NTT_KERNEL == "pallas"
+    if _NTT_KERNEL != "auto":
+        raise ValueError(
+            f"DPT_NTT_KERNEL must be auto|pallas|xla, got {_NTT_KERNEL!r}")
+    return jax.default_backend() == "tpu"
+
+
+def _active_kernel(kernel=None):
+    """Resolve the stage-core kernel: explicit argument > DPT_NTT_KERNEL.
+    Read per call like _active_radix; the pallas_disabled guard wins
+    even over an explicit 'pallas' (same invariant as msm_jax)."""
+    if kernel is not None:
+        if kernel not in ("pallas", "xla"):
+            raise ValueError(
+                f"NTT kernel must be 'pallas' or 'xla', got {kernel!r}")
+        if kernel == "pallas" and getattr(FJ._pallas_off, "v", False):
+            return "xla"
+        return kernel
+    return "pallas" if _use_pallas_kernel() else "xla"
+
+
 def _mont_table(xs):
     """Host ints -> (16, len) Montgomery-form limb table."""
     return ints_to_limbs([x * FR_MONT_R % R_MOD for x in xs], FR_LIMBS)
@@ -262,11 +302,19 @@ def batched_butterflies(v, perm, exps, pow_tab):
 
 def run_stages(v, consts):
     """Shared stage core: (16, B, n) natural-order Montgomery rows ->
-    (i)NTT in natural order (1/n scaling NOT included). The radix is
-    carried by the table set (`NttPlan.core_consts`): radix-4 tables hold
-    "exps4" (+ "fix_exps" for odd log2(n)), radix-2 tables hold "exps".
-    Single-device kernels, the mesh 4-step NTT stages, and the fleet
-    panel kernels all run their butterflies through this entry point."""
+    (i)NTT in natural order (1/n scaling NOT included). The kernel and
+    radix are carried by the table set (`NttPlan.core_consts`): pallas
+    tables hold "pg{g}s{t}" fused-stage twiddle blocks, radix-4 tables
+    hold "exps4" (+ "fix_exps" for odd log2(n)), radix-2 tables hold
+    "exps". Single-device kernels, the mesh 4-step NTT stages, and the
+    fleet panel kernels all run their butterflies through this entry
+    point, so one DPT_NTT_KERNEL / DPT_NTT_RADIX flip covers every path.
+    The pallas dispatch re-checks the guard at trace time: inside
+    pallas_disabled()/pallas_guard the XLA tables (always present) run
+    instead — bit-identical either way."""
+    if _use_pallas_kernel() and any(k.startswith("pg") for k in consts):
+        from . import ntt_pallas
+        return ntt_pallas.run_groups(v, consts)[:, :, consts["perm"]]
     if "exps4" in consts:
         return _radix4_core(v, consts)[:, :, consts["perm"]]
     return batched_butterflies(v, consts["perm"], consts["exps"],
@@ -311,6 +359,7 @@ class NttPlan:
         self.inv_coset_tab = _mont_table(_powers(fr_inv(g), n, start=n_inv))
         self.n_inv_tab = _mont_table([n_inv])
         self._fns = {}
+        self._pallas_tabs = {}
 
     def _effective_radix(self, radix=None):
         """Active radix for this plan: n <= 2 has no radix-4 stage, so the
@@ -318,38 +367,94 @@ class NttPlan:
         radix = _active_radix(radix)
         return radix if self.exps4 is not None else 2
 
-    def core_consts(self, inverse=False, radix=None):
-        """HOST (numpy) table set for `run_stages` at the active radix.
-        Callers (mesh shard_map consts, fleet panel kernels) place these
-        on device / build PartitionSpecs per entry; every entry is
-        replicated-safe (O(n) tables, no per-shard content)."""
+    def _effective_kernel(self, kernel=None):
+        """Active stage-core kernel for this plan: n <= 2 has no fused
+        group schedule, so the XLA body covers it (like radix)."""
+        if self.log_n < 2:
+            return "xla"
+        return _active_kernel(kernel)
+
+    def _pallas_consts(self, inverse):
+        """Fused-group twiddle VALUE tables (host numpy, cached per
+        schedule — the schedule moves with the VMEM/group-cap knobs)."""
+        from . import ntt_pallas
+
+        schedule = ntt_pallas.plan_schedule(self.log_n)
+        key = (inverse, schedule)
+        if key not in self._pallas_tabs:
+            pow_tab = self.pow_inv if inverse else self.pow_fwd
+            self._pallas_tabs[key] = ntt_pallas.group_tables(
+                self.log_n, self.exps, pow_tab, schedule)
+        return self._pallas_tabs[key]
+
+    def core_consts(self, inverse=False, radix=None, kernel=None):
+        """HOST (numpy) table set for `run_stages` at the active radix
+        and kernel. Callers (mesh shard_map consts, fleet panel kernels)
+        place these on device / build PartitionSpecs per entry; every
+        entry is replicated-safe (O(n) tables, no per-shard content).
+        Under the pallas kernel the fused-stage twiddle blocks ride
+        ALONGSIDE the XLA tables — run_stages falls back to the XLA body
+        whenever the guard disables pallas at trace time."""
         pow_tab = self.pow_inv if inverse else self.pow_fwd
         if self._effective_radix(radix) == 4:
             out = {"perm": self.perm, "exps4": self.exps4, "pow": pow_tab}
             if self.fix_exps is not None:
                 out["fix_exps"] = self.fix_exps
-            return out
-        return {"perm": self.perm, "exps": self.exps, "pow": pow_tab}
+        else:
+            out = {"perm": self.perm, "exps": self.exps, "pow": pow_tab}
+        if self._effective_kernel(kernel) == "pallas":
+            out.update(self._pallas_consts(inverse))
+        return out
 
-    def _kernel_consts(self, inverse, coset, radix):
+    def _pallas_post_tab(self, coset):
+        """Inverse scales reordered for pre-permutation application in
+        the LAST fused group: s = post[perm] (bit reversal is an
+        involution), laid out (16, rows_last, M_last) to match the
+        kernel's in-VMEM block orientation."""
+        from . import ntt_pallas
+
+        schedule = ntt_pallas.plan_schedule(self.log_n)
+        rows = 1 << schedule[-1][1]
+        m_cols = self.n // rows
+        post = (self.inv_coset_tab if coset
+                else np.broadcast_to(self.n_inv_tab, (FR_LIMBS, self.n)))
+        s = post[:, self.perm]
+        return np.ascontiguousarray(
+            s.reshape(FR_LIMBS, m_cols, rows).swapaxes(1, 2))
+
+    def _kernel_consts(self, inverse, coset, radix, kernel="xla"):
         """Traced-argument tables for one compiled kernel variant."""
         consts = {k: jnp.asarray(v)
-                  for k, v in self.core_consts(inverse, radix).items()}
+                  for k, v in self.core_consts(inverse, radix,
+                                               kernel=kernel).items()}
         if coset and not inverse:
             consts["pre"] = jnp.asarray(self.coset_tab)
+            if kernel == "pallas":
+                # the pallas first group consumes the SAME coset table,
+                # viewed (16, rows, M) — a reshape, not a new precompute
+                consts["ppre"] = consts["pre"]
         if inverse:
             consts["post"] = jnp.asarray(
                 self.inv_coset_tab if coset else self.n_inv_tab)
+            if kernel == "pallas":
+                consts["ppost"] = jnp.asarray(self._pallas_post_tab(coset))
         return consts
 
-    def _apply_batched(self, v, consts, radix):
+    def _apply_batched(self, v, consts, radix, kernel="xla"):
         """(16, B, n) Montgomery rows -> full (i)(coset)NTT: butterflies +
-        output permutation + fused scales, radix-selected. The radix-4
-        path peels the first/last stages so the coset tables ride the
-        first butterfly and the perm gather + inverse scales fuse with
-        the last one; the radix-2 path keeps the historical standalone
-        pre/post table multiplies (parity/debug reference)."""
+        output permutation + fused scales, radix/kernel-selected. The
+        pallas path runs the fused multi-stage groups (coset pre-scale in
+        the first group, inverse scales in the last) and finishes with
+        the bit-reversal gather; the radix-4 path peels the first/last
+        stages so the coset tables ride the first butterfly and the perm
+        gather + inverse scales fuse with the last one; the radix-2 path
+        keeps the historical standalone pre/post table multiplies
+        (parity/debug reference)."""
         n = self.n
+        if kernel == "pallas" and _active_kernel("pallas") == "pallas":
+            from . import ntt_pallas
+            v = ntt_pallas.run_groups(v, consts)
+            return v[:, :, consts["perm"]]
         if radix == 4:
             v = _radix4_core(v, consts, coset_pre="pre" in consts)
             v = v[:, :, consts["perm"]]
@@ -365,28 +470,33 @@ class NttPlan:
             v = FJ.mont_mul(FR, v, post[:, None, :])
         return v
 
-    def kernel(self, inverse=False, coset=False, boundary="mont", radix=None):
+    def kernel(self, inverse=False, coset=False, boundary="mont", radix=None,
+               kernel=None):
         """Jitted (16, n) -> (16, n) kernel.
 
         boundary="mont": input/output in Montgomery form (device-resident
         pipelines). boundary="plain": canonical-form input/output (host
         round-trips); conversion is fused into the same XLA program.
 
-        The O(n) tables (permutation, exponents, power table, coset scales)
-        are passed as traced arguments, not baked-in constants, so compiled
-        programs and persistent-cache entries stay small.
+        The O(n) tables (permutation, exponents, power table, coset scales,
+        fused-stage twiddle blocks) are passed as traced arguments, not
+        baked-in constants, so compiled programs and persistent-cache
+        entries stay small. `kernel` overrides DPT_NTT_KERNEL like `radix`
+        overrides DPT_NTT_RADIX; the memo is keyed on the resolved mode.
         """
         radix = self._effective_radix(radix)
-        key = (inverse, coset, boundary, radix)
+        kmode = self._effective_kernel(kernel)
+        key = (inverse, coset, boundary, radix, kmode)
         if key not in self._fns:
             plain = boundary == "plain"
-            consts = self._kernel_consts(inverse, coset, radix)
+            consts = self._kernel_consts(inverse, coset, radix, kmode)
 
             @jax.jit
             def fn(v, consts):
                 if plain:
                     v = FJ.to_mont(FR, v)
-                v = self._apply_batched(v[:, None, :], consts, radix)[:, 0, :]
+                v = self._apply_batched(v[:, None, :], consts, radix,
+                                        kmode)[:, 0, :]
                 if plain:
                     v = FJ.from_mont(FR, v)
                 return v
@@ -395,59 +505,109 @@ class NttPlan:
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
-    def kernel_batch(self, inverse=False, coset=False, radix=None):
+    def kernel_batch(self, inverse=False, coset=False, radix=None,
+                     kernel=None):
         """Jitted (16, B, n) -> (16, B, n) Montgomery-boundary kernel: B
         polynomials in ONE launch (the prover's round-1/round-3 NTT batches;
         the reference fans these out as concurrent RPCs,
         dispatcher2.rs:294-321,382-414 — on device they are one program).
-        Compiled once per (mode, radix, B)."""
+        Compiled once per (mode, radix, kernel, B)."""
         radix = self._effective_radix(radix)
-        key = (inverse, coset, "batch", radix)
+        kmode = self._effective_kernel(kernel)
+        key = (inverse, coset, "batch", radix, kmode)
         if key not in self._fns:
-            consts = self._kernel_consts(inverse, coset, radix)
+            consts = self._kernel_consts(inverse, coset, radix, kmode)
 
             @jax.jit
             def fn(v, consts):
-                return self._apply_batched(v, consts, radix)
+                return self._apply_batched(v, consts, radix, kmode)
 
             self._fns[key] = (fn, consts)
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
+    def kernel_fused(self, inverse=False, coset=False, *, key,
+                     prologue=None, epilogue=None, radix=None, kernel=None):
+        """Jitted Montgomery-boundary batch kernel with caller-supplied
+        pointwise stages fused into the SAME program:
+
+            prologue(*pro_args) -> (16, B, n)  [optional]
+            -> (i)(coset)NTT batch
+            -> epilogue(result, *epi_args)     [optional]
+
+        This is how round 3 loses its standalone O(n) passes: the gate /
+        sigma quotient products run as the epilogue of the selector and
+        sigma coset-FFT launches (XLA fuses them with the final stage /
+        output permutation, so the (16, B, m) planes never round-trip
+        HBM), and the quotient combine runs as the prologue of the coset
+        iNTT (fusing into the first inverse stage's reads). `key` must
+        uniquely identify the prologue/epilogue semantics — the traced
+        closure is memoized under (key, mode) exactly like the plain
+        kernels. Returns fn(pro_args, epi_args=())."""
+        radix = self._effective_radix(radix)
+        kmode = self._effective_kernel(kernel)
+        ck = ("fused", key, inverse, coset, radix, kmode)
+        if ck not in self._fns:
+            consts = self._kernel_consts(inverse, coset, radix, kmode)
+
+            @jax.jit
+            def fn(pro_args, epi_args, consts):
+                v = prologue(*pro_args) if prologue is not None \
+                    else pro_args[0]
+                v = self._apply_batched(v, consts, radix, kmode)
+                if epilogue is not None:
+                    return epilogue(v, *epi_args)
+                return v
+
+            # `key` contractually identifies the prologue/epilogue
+            # semantics (docstring) — callers rebuild structurally
+            # identical closures per key; folding closure ids into the
+            # key would retrace every prove for nothing
+            self._fns[ck] = (fn, consts)  # analysis: ok(key identifies prologue/epilogue by contract)
+        fn, consts = self._fns[ck]
+        return lambda pro_args, epi_args=(): fn(tuple(pro_args),
+                                                tuple(epi_args), consts)
+
     def traced_kernel(self, inverse=False, coset=False, boundary="mont",
-                      radix=None, batch=False):
+                      radix=None, batch=False, kernel=None):
         """(jitted fn, consts dict) for one kernel variant — the raw
         pair behind `kernel`/`kernel_batch`'s memo. The static verifier
         (analysis/registry.py) traces `fn(v, consts)` through
-        jax.make_jaxpr to interval-check the whole stage pipeline; AOT
-        tooling can reuse it for explicit lower()/compile() too."""
+        jax.make_jaxpr to interval-check the whole stage pipeline
+        (including the pallas_call kernel jaxprs under kernel="pallas");
+        AOT tooling can reuse it for explicit lower()/compile() too."""
         radix = self._effective_radix(radix)
+        kmode = self._effective_kernel(kernel)
         if batch:
             if boundary != "mont":
                 raise ValueError(
                     "batch kernels are Montgomery-boundary only")
-            self.kernel_batch(inverse, coset, radix=radix)
-            key = (inverse, coset, "batch", radix)
+            self.kernel_batch(inverse, coset, radix=radix, kernel=kmode)
+            key = (inverse, coset, "batch", radix, kmode)
         else:
-            self.kernel(inverse, coset, boundary=boundary, radix=radix)
-            key = (inverse, coset, boundary, radix)
+            self.kernel(inverse, coset, boundary=boundary, radix=radix,
+                        kernel=kmode)
+            key = (inverse, coset, boundary, radix, kmode)
         return self._fns[key]
 
     def aot_compile(self, batch_sizes=(), boundaries=("mont", "plain"),
-                    radix=None):
+                    radix=None, kernel=None):
         """Ahead-of-time lower + compile every (inverse, coset) kernel
-        variant for this domain at the ACTIVE radix, plus `kernel_batch`
-        at the given batch widths, WITHOUT running anything —
-        `jit.lower(shapes).compile()` on ShapeDtypeStructs.
+        variant for this domain at the ACTIVE radix and kernel mode, plus
+        `kernel_batch` at the given batch widths, WITHOUT running anything
+        — `jit.lower(shapes).compile()` on ShapeDtypeStructs.
 
         The executables land in the persistent compilation cache
         (field_jax.configure_compile_cache), which is the point: a warmup
         process can pre-bake a store-owned cache so every later server
-        start compiles nothing for this shape. The in-process jit dispatch
-        still traces on first real call, but its compile is then a disk
-        hit, not an XLA run. Returns {"compiled": k, "failed": j, "radix": r}.
+        start compiles nothing for this shape. Mode-aware like
+        MsmContext.aot_compile: under DPT_NTT_KERNEL=pallas the lowered
+        programs ARE the fused multi-stage Mosaic kernels, so
+        `warm_stages` / `scripts/warmup.py --aot` pre-bake those too.
+        Returns {"compiled": k, "failed": j, "radix": r, "kernel": mode}.
         """
         radix = self._effective_radix(radix)
+        kmode = self._effective_kernel(kernel)
         compiled = failed = 0
         v_spec = jax.ShapeDtypeStruct((FR_LIMBS, self.n), jnp.uint32)
 
@@ -465,24 +625,30 @@ class NttPlan:
             for coset in (False, True):
                 for boundary in boundaries:
                     self.kernel(inverse, coset, boundary=boundary,
-                                radix=radix)
-                    fn, consts = self._fns[(inverse, coset, boundary, radix)]
+                                radix=radix, kernel=kmode)
+                    fn, consts = self._fns[
+                        (inverse, coset, boundary, radix, kmode)]
                     aot(fn, consts, v_spec)
                 for b in batch_sizes:
-                    self.kernel_batch(inverse, coset, radix=radix)
-                    fn, consts = self._fns[(inverse, coset, "batch", radix)]
+                    self.kernel_batch(inverse, coset, radix=radix,
+                                      kernel=kmode)
+                    fn, consts = self._fns[
+                        (inverse, coset, "batch", radix, kmode)]
                     aot(fn, consts,
                         jax.ShapeDtypeStruct((FR_LIMBS, b, self.n),
                                              jnp.uint32))
-        return {"compiled": compiled, "failed": failed, "radix": radix}
+        return {"compiled": compiled, "failed": failed, "radix": radix,
+                "kernel": kmode}
 
     # --- host-boundary convenience (int lists, zero-padded to n) -------------
 
-    def run_ints(self, values, inverse=False, coset=False, radix=None):
+    def run_ints(self, values, inverse=False, coset=False, radix=None,
+                 kernel=None):
         assert len(values) <= self.n
         padded = list(values) + [0] * (self.n - len(values))
         v = jnp.asarray(ints_to_limbs(padded, FR_LIMBS))
-        out = self.kernel(inverse, coset, boundary="plain", radix=radix)(v)
+        out = self.kernel(inverse, coset, boundary="plain", radix=radix,
+                          kernel=kernel)(v)
         return limbs_to_ints(np.asarray(out))
 
 

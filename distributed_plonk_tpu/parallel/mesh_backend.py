@@ -61,6 +61,7 @@ class MeshBackend(JaxBackend):
     # memory strategy here is sharding, not streaming+packing: slicing a
     # GSPMD-sharded lane axis per quotient chunk would reshard every slice
     quotient_streamed = None
+    quotient_poly_streamed = None
 
     # minimum per-device coefficient count for sharding a handle: below
     # this, elementwise/scan round math runs REPLICATED on the mesh

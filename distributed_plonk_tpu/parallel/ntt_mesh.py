@@ -31,10 +31,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+# version-compat shard_map wrapper (check_vma/check_rep) — needed to
+# disable the replication checker when the body traces a pallas_call,
+# which has no replication rule (same workaround as the mesh MSM's
+# pallas scans; the shim owns the jax-version fallback too)
+from .msm_mesh import _shard_map as _shard_map_compat
 
 from ..constants import R_MOD, FR_GENERATOR, FR_LIMBS
 from ..fields import fr_inv, fr_root_of_unity
@@ -103,9 +104,19 @@ class MeshNttPlan:
 
     def kernel(self, inverse=False, coset=False, boundary="mont"):
         """Compiled (16, n) -> (16, n) mesh program for one mode (at the
-        active DPT_NTT_RADIX — part of the cache key, like the
-        single-device kernels)."""
-        key = (inverse, coset, boundary, ntt_jax._active_radix())
+        active DPT_NTT_RADIX and DPT_NTT_KERNEL — part of the cache key,
+        like the single-device kernels; under the pallas kernel the
+        per-shard run_stages calls pick up the fused multi-stage kernel
+        unchanged, and pallas_guard falls them back to the XLA tables on
+        a non-TPU mesh at trace time)."""
+        key = (inverse, coset, boundary, ntt_jax._active_radix(),
+               ntt_jax._active_kernel())
+        # will the TRACED body actually run pallas? Resolve under the
+        # same guard the trace runs under (pallas_guard disables it for
+        # a non-TPU mesh), so check_vma below is only relaxed for
+        # programs that genuinely contain a pallas_call
+        with pallas_guard(self.mesh):
+            pallas_active = ntt_jax._active_kernel() == "pallas"
         if key in self._fns:
             fn, consts = self._fns[key]
             return lambda v: fn(v, consts)
@@ -163,9 +174,14 @@ class MeshNttPlan:
                 v = FJ.mont_mul(FR, v, post)
             return v
 
-        smapped = _shard_map(
+        # a pallas_call has no shard_map replication rule: disable the
+        # checker ONLY when the traced body will contain one — every
+        # XLA-core program (including pallas-requested-but-guarded-off
+        # on a non-TPU mesh) keeps the full replication check
+        smapped = _shard_map_compat(
             sharded_body, mesh=self.mesh,
-            in_specs=(row_spec, const_specs), out_specs=row_spec)
+            in_specs=(row_spec, const_specs), out_specs=row_spec,
+            **({"check_vma": False} if pallas_active else {}))
 
         lane_sh = jax.sharding.NamedSharding(self.mesh, P(None, SHARD_AXIS))
 

@@ -172,6 +172,11 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
     # backend (whose memory strategy is sharding) run the one-shot
     # unpacked path. Both compute identical values.
     stream = getattr(backend, "quotient_streamed", None)
+    # quotient_poly_streamed: same streaming accumulation, but the final
+    # pointwise combine fuses into the coset iNTT program (and the gate/
+    # sigma folds into their FFT programs) — round 3 straight to the
+    # quotient polynomial with no standalone O(m) passes (DPT_R3_FUSE)
+    stream_poly = getattr(backend, "quotient_poly_streamed", None)
     if start >= 3:
         # the round-3 snapshot was taken AFTER the quot-comms transcript
         # absorb, so restoring it must not absorb them again
@@ -187,7 +192,15 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
         with tr.span("round3"):
             pi_coeffs = backend.ifft_h(
                 domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
-            if stream is not None:
+            quot_evals = None
+            if stream_poly is not None:
+                with tr.span("quotient_stream_fused", m=m,
+                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                    quotient_poly = stream_poly(
+                        n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
+                        alpha_sq_div_n, sel_h, sigma_h, wire_polys,
+                        permutation_poly, pi_coeffs)
+            elif stream is not None:
                 with tr.span("quotient_stream", m=m,
                              polys=len(sel_h) + 2 * num_wire_types + 2):
                     quot_evals = stream(
@@ -219,8 +232,10 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
                     )
                     del batch, selectors_coset, sigmas_coset, wires_coset
                     del z_coset, pi_coset
-            with tr.span("coset_ifft_quot"):
-                quotient_poly = backend.coset_ifft_h(quot_domain, quot_evals)
+            if quot_evals is not None:
+                with tr.span("coset_ifft_quot"):
+                    quotient_poly = backend.coset_ifft_h(quot_domain,
+                                                         quot_evals)
 
             expected_degree = num_wire_types * (n + 1) + 2
             assert backend.degree_is(quotient_poly, expected_degree), \

@@ -51,7 +51,12 @@ class StageKernels:
         return FJ.from_mont(FR, v)
 
     def _plan_consts(self, size, inverse):
-        key = ("plan", size, inverse, ntt_jax._active_radix())
+        # keyed on the active radix AND kernel (DPT_NTT_KERNEL): pallas
+        # table sets carry the fused-stage twiddle blocks alongside the
+        # XLA tables, so the fleet panels follow the same dispatch knob
+        # as the single-device and mesh paths
+        key = ("plan", size, inverse, ntt_jax._active_radix(),
+               ntt_jax._active_kernel())
         if key not in self._tables:
             plan = ntt_jax.get_plan(size)
             self._tables[key] = {

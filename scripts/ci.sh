@@ -42,7 +42,8 @@ if [ "$1" = "fast" ]; then
   # the fault-domain acceptance surface before kernel-parity compiles start
   bash scripts/ci.sh chaos || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_ntt_jax.py tests/test_curve_msm_jax.py \
+    tests/test_ntt_jax.py tests/test_ntt_pallas.py \
+    tests/test_curve_msm_jax.py \
     tests/test_msm_update_paths.py tests/test_msm_pallas.py \
     tests/test_poly.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly

@@ -67,6 +67,35 @@ def test_mutant_python_float_is_caught():
     assert v and any("integer-valued" in x.message for x in v)
 
 
+def test_floor_remainder_chain_is_bounded_and_mutants_caught():
+    """The pow2-rescale/floor provenance rules (the lazy-carry local
+    rounds): the exact x - floor(x*2^-8)*256 remainder proves < 256,
+    while (a) a mismatched restore base and (b) a non-pow2 scale are
+    NOT granted the remainder bound / exactness."""
+    import numpy as np_
+
+    def local_round(cols):
+        hi = jnp.floor(cols * np_.float32(1.0 / 256.0))
+        return cols - hi * np_.float32(256.0)
+
+    f32_in = (B.Bound((8, 4), jnp.float32, 0, 1 << 22),)
+    assert B.check_fn("ok", local_round, f32_in,
+                      out_bounds=[(0, 255)]) == []
+
+    def wrong_base(cols):  # MUTANT: restores with 512, not 256
+        hi = jnp.floor(cols * np_.float32(1.0 / 256.0))
+        return cols - hi * np_.float32(512.0)
+
+    v = B.check_fn("mutant", wrong_base, f32_in, out_bounds=[(0, 255)])
+    assert v and any(x.prim == "output" for x in v)
+
+    def not_pow2(cols):  # MUTANT: 1/320 scaling is NOT exact in f32
+        return jnp.floor(cols * np_.float32(1.0 / 320.0))
+
+    v = B.check_fn("mutant", not_pow2, f32_in)
+    assert v and any("integer-valued" in x.message for x in v)
+
+
 def test_mutant_unbounded_scan_carry_is_caught():
     from jax import lax
 
@@ -237,6 +266,7 @@ def test_repo_lints_clean():
     ("ntt/n32_radix4_inv0_coset1_mont", "ntt/n32_radix2"),
     ("msm/digits_signed_c7_L66", "msm/bucket_scan_signed_onehot_packed"),
     ("msm/bucket_pallas_signed_c7_packed",),
+    ("ntt/n32_pallas", "field/fr_mont_mul_pallas_lazy"),
     ("curve/proj_add",),
 ])
 def test_registry_subset_clean(subset):

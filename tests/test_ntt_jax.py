@@ -64,10 +64,10 @@ def test_radix_env_knob(monkeypatch):
     plan = get_plan(64)
     monkeypatch.setenv("DPT_NTT_RADIX", "2")
     plan.kernel(boundary="plain")
-    assert (False, False, "plain", 2) in plan._fns
+    assert (False, False, "plain", 2, "xla") in plan._fns
     monkeypatch.setenv("DPT_NTT_RADIX", "4")
     plan.kernel(boundary="plain")
-    assert (False, False, "plain", 4) in plan._fns
+    assert (False, False, "plain", 4, "xla") in plan._fns
     monkeypatch.setenv("DPT_NTT_RADIX", "3")
     with pytest.raises(ValueError):
         plan.kernel(boundary="plain")
