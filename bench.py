@@ -580,19 +580,36 @@ def service_roundtrip_main():
     store_dir = tempfile.mkdtemp(prefix="dpt-bench-store-")
 
     def one_run(seed):
-        """(roundtrip_s, status, header, blob, metrics) for one fresh
-        service process-equivalent (new ProofService, same store)."""
+        """(roundtrip_s, status, header, blob, metrics, trace_info) for
+        one fresh service process-equivalent (new ProofService, same
+        store). The job is submitted under a bench-owned trace id, so
+        trace_info pins the whole propagation + artifact path: spans
+        collected under OUR id, and the content digest of the stored
+        trace:<job_id> artifact."""
+        from distributed_plonk_tpu.store import keycache as KC
+        from distributed_plonk_tpu.trace import Tracer
         t0 = time.perf_counter()
         svc = ProofService(port=0, prover_workers=1, store_dir=store_dir)
         svc.start()
+        tracer = Tracer(proc="bench")
+        trace_info = {"spans": 0, "digest": None, "adopted": False}
         try:
             with ServiceClient("127.0.0.1", svc.port) as c:
-                jid = c.submit({"kind": "toy", "gates": 16,
-                                "seed": seed})["job_id"]
-                st = c.wait(jid, timeout_s=240)
+                with tracer.span("bench/service_roundtrip") as root:
+                    r = c.submit({"kind": "toy", "gates": 16, "seed": seed},
+                                 trace_ctx={"trace_id": tracer.trace_id,
+                                            "parent_id": root})
+                    jid = r["job_id"]
+                    st = c.wait(jid, timeout_s=240)
                 header, blob = c.result(jid)
                 m = c.metrics()
-            return time.perf_counter() - t0, st, header, blob, m
+            trace_info["adopted"] = r.get("trace_id") == tracer.trace_id
+            trace_info["spans"] = st.get("trace_spans") or 0
+            entry = svc.store.get_entry(KC.trace_store_key(jid))
+            if entry is not None:
+                trace_info["digest"] = entry[1]
+            return (time.perf_counter() - t0, st, header, blob, m,
+                    trace_info)
         finally:
             svc.shutdown()
 
@@ -650,8 +667,8 @@ def service_roundtrip_main():
             shutil.rmtree(journal_dir, ignore_errors=True)
 
     try:
-        cold_s, st, header, blob, m_cold = one_run(seed=42)
-        warm_s, st_w, _hw, _bw, m_warm = one_run(seed=43)
+        cold_s, st, header, blob, m_cold, trace_info = one_run(seed=42)
+        warm_s, st_w, _hw, _bw, m_warm, _tw = one_run(seed=43)
         recovery_ok, recovery_resumes = restart_recovery_run()
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
@@ -674,6 +691,12 @@ def service_roundtrip_main():
             # completed rounds (the PR 7 durability canary)
             "service_restart_recovery_ok": bool(recovery_ok),
             "service_restart_resumes": recovery_resumes,
+            # contract: the job proved under the BENCH's trace id end to
+            # end, and its merged timeline is a content-addressed store
+            # artifact (trace:<job_id>) — the PR 9 observability canary
+            "trace_spans_total": trace_info["spans"],
+            "trace_ctx_adopted": bool(trace_info["adopted"]),
+            "trace_artifact_digest": trace_info["digest"],
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
             "service_jobs_completed":

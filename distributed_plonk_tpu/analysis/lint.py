@@ -1,6 +1,6 @@
 """AST-level repo hazard lints (the sub-second half of the verifier).
 
-Three lint families, each targeting a bug class this repo has actually
+Four lint families, each targeting a bug class this repo has actually
 shipped or nearly shipped:
 
 JIT01 jit-cache-key: a jit-compiled callable is stored in a cache dict
@@ -30,6 +30,18 @@ LOCK01/LOCK02 lock discipline (service/ + store/): a self attribute of
     (fixpoint), so `_delete_locked`-style internals don't
     false-positive.
 
+OBS01 metric glossary (service/ + runtime/ + store/): a metric name
+    recorded via a string-literal `.inc("name")` / `.observe("name")`
+    must be documented in service/metrics.py's module docstring — the
+    glossary is the operator's only map from a /metrics line to what
+    the code actually counted, and undocumented names rot into
+    write-only telemetry. Documented = the name (or a `family_*`
+    wildcard covering it) appears on one of the docstring's indented
+    glossary lines; names published through a scoped registry
+    (Metrics.scoped) also pass when their store_-prefixed form is
+    documented. F-string/derived names are out of scope (they are
+    families; document the wildcard).
+
 Suppression: append `# analysis: ok(<reason>)` to the flagged line (or
 the line above) — deliberate exceptions stay visible and reasoned at
 the site. Pragmas are honored by every lint.
@@ -51,6 +63,9 @@ KERNEL_DIRS = ("backend", "parallel", "runtime")
 # (runtime/ added with the fleet fault domain: LivenessTracker state,
 # WorkerState task tables, peer-connection caches are all cross-thread)
 LOCK_DIRS = ("service", "store", "runtime")
+# modules that record metrics into the shared registry: the OBS01
+# glossary lint runs here
+OBS_DIRS = ("service", "store", "runtime")
 
 # mutating container-method names treated as writes by LOCK01 (calls on
 # self.<attr>.<name>(...)); read-only or thread-safe APIs (queue.put,
@@ -439,6 +454,66 @@ def _lint_locks(tree, path, src, findings):
                     f"the lock in {method}()"))
 
 
+# --- OBS01: metric-name glossary ----------------------------------------------
+
+_GLOSSARY_PATH = os.path.join(_PKG, "service", "metrics.py")
+_GLOSSARY_TOKEN_RE = re.compile(r"[a-z][a-z0-9_/]*(?:\*)?")
+
+
+def parse_glossary(doc):
+    """(exact names, wildcard prefixes) from a glossary docstring. Only
+    the NAME COLUMN of indented entry lines is read — the entry format
+    is `    name [/ name...]  description`, names separated from the
+    description by >= 2 spaces — so prose (descriptions, paragraphs)
+    can't accidentally document a metric; a token `family_*` (or
+    `family/*`) documents every name under that prefix."""
+    exact, prefixes = set(), []
+    for line in doc.splitlines():
+        if not line.startswith("    ") or not line.strip():
+            continue
+        name_col = re.split(r"\s{2,}", line.strip(), maxsplit=1)[0]
+        for tok in _GLOSSARY_TOKEN_RE.findall(name_col):
+            if tok.endswith("*"):
+                prefixes.append(tok[:-1])
+            else:
+                exact.add(tok)
+    return exact, tuple(prefixes)
+
+
+def _load_glossary():
+    with open(_GLOSSARY_PATH) as f:
+        tree = ast.parse(f.read(), filename=_GLOSSARY_PATH)
+    return parse_glossary(ast.get_docstring(tree) or "")
+
+
+def _documented(name, glossary):
+    exact, prefixes = glossary
+    for n in (name, "store_" + name):  # scoped-registry publication
+        if n in exact or any(n.startswith(p) for p in prefixes):
+            return True
+    return False
+
+
+def _lint_obs(tree, path, src, findings, glossary):
+    pragmas = _pragma_lines(src)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "observe")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        name = node.args[0].value
+        if _documented(name, glossary) or _suppressed(pragmas, node.lineno):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "OBS01",
+            f"metric {name!r} is recorded here but absent from the "
+            "service/metrics.py glossary — document it (or a matching "
+            "`family_*` wildcard) so the /metrics line stays legible"))
+
+
 # --- driver -------------------------------------------------------------------
 
 def _module_globals(tree):
@@ -473,7 +548,8 @@ def run_lints(pkg_root=_PKG):
     """All lints over their target directories. Returns [Finding]."""
     findings = []
     seen = set()
-    for path in _iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS):
+    glossary = _load_glossary()
+    for path in _iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS + OBS_DIRS):
         if path in seen:
             continue
         seen.add(path)
@@ -488,11 +564,16 @@ def run_lints(pkg_root=_PKG):
             _lint_promotion(tree, path, src, findings)
         if top in LOCK_DIRS:
             _lint_locks(tree, path, src, findings)
+        if top in OBS_DIRS:
+            _lint_obs(tree, path, src, findings, glossary)
     return findings
 
 
-def lint_source(src, path="<string>", kinds=("jit", "prom", "lock")):
-    """Lint one source string (unit tests / editor integration)."""
+def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
+                glossary_doc=None):
+    """Lint one source string (unit tests / editor integration).
+    glossary_doc: docstring text for the "obs" kind (defaults to the
+    real service/metrics.py glossary)."""
     findings = []
     tree = ast.parse(src, filename=path)
     if "jit" in kinds:
@@ -501,4 +582,8 @@ def lint_source(src, path="<string>", kinds=("jit", "prom", "lock")):
         _lint_promotion(tree, path, src, findings)
     if "lock" in kinds:
         _lint_locks(tree, path, src, findings)
+    if "obs" in kinds:
+        glossary = parse_glossary(glossary_doc) \
+            if glossary_doc is not None else _load_glossary()
+        _lint_obs(tree, path, src, findings, glossary)
     return findings

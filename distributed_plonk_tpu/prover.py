@@ -25,7 +25,7 @@ from .constants import R_MOD
 from .fields import fr_inv
 from .poly import Domain
 from .circuit import NUM_WIRE_TYPES, Q_LC, Q_MUL, Q_HASH, Q_O, Q_C, Q_ECC
-from .trace import NULL_TRACER
+from .trace import NULL_TRACER, msm_flops, ntt_flops
 from .transcript import StandardTranscript
 
 
@@ -104,16 +104,23 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
 
     # --- Round 1: wire polynomials -------------------------------------------
     # (reference src/dispatcher2.rs:293-323)
+    # kernel spans carry the flops/bytes attribution model (trace.py) so
+    # the merged timeline and the live MFU gauges (Metrics.observe_kernels)
+    # can say where device time went, not just that it went
     if start < 1:
         with tr.span("round1"):
-            with tr.span("ifft_wires", polys=num_wire_types):
+            with tr.span("ifft_wires", polys=num_wire_types,
+                         flops=ntt_flops(n, num_wire_types),
+                         data_bytes=num_wire_types * n * 32):
                 # one batch call: concurrent across the fleet (join_all,
                 # reference dispatcher2.rs:294-306) / one launch on device
                 wire_coeffs = backend.ifft_many(domain,
                                                 backend.wire_values(circuit))
                 wire_polys = [backend.blind(coeffs, _rand(rng, 2), n)
                               for coeffs in wire_coeffs]
-            with tr.span("commit_wires", polys=num_wire_types):
+            with tr.span("commit_wires", polys=num_wire_types,
+                         flops=msm_flops(n + 2, num_wire_types),
+                         data_bytes=num_wire_types * (n + 2) * 32):
                 wires_poly_comms = backend.commit_many_h(ck, wire_polys)
         transcript.append_commitments(b"witness_poly_comms", wires_poly_comms)
         if checkpoint is not None:
@@ -139,10 +146,12 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
         with tr.span("round2"):
             with tr.span("perm_product"):
                 product_h = backend.perm_product(circuit, beta, gamma, n)
-            with tr.span("ifft_perm"):
+            with tr.span("ifft_perm", flops=ntt_flops(n),
+                         data_bytes=n * 32):
                 perm_coeffs = backend.ifft_h(domain, product_h)
             permutation_poly = backend.blind(perm_coeffs, _rand(rng, 3), n)
-            with tr.span("commit_perm"):
+            with tr.span("commit_perm", flops=msm_flops(n + 3),
+                         data_bytes=(n + 3) * 32):
                 prod_perm_poly_comm = backend.commit_h(ck, permutation_poly)
         transcript.append_commitment(b"perm_poly_comms", prod_perm_poly_comm)
         if checkpoint is not None:
@@ -193,23 +202,28 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
             pi_coeffs = backend.ifft_h(
                 domain, backend.lift(pub_input + [0] * (n - len(pub_input))))
             quot_evals = None
+            n_coset_polys = len(sel_h) + 2 * num_wire_types + 2
             if stream_poly is not None:
                 with tr.span("quotient_stream_fused", m=m,
-                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                             polys=n_coset_polys,
+                             flops=ntt_flops(m, n_coset_polys + 1),
+                             data_bytes=n_coset_polys * m * 32):
                     quotient_poly = stream_poly(
                         n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
                         alpha_sq_div_n, sel_h, sigma_h, wire_polys,
                         permutation_poly, pi_coeffs)
             elif stream is not None:
-                with tr.span("quotient_stream", m=m,
-                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                with tr.span("quotient_stream", m=m, polys=n_coset_polys,
+                             flops=ntt_flops(m, n_coset_polys),
+                             data_bytes=n_coset_polys * m * 32):
                     quot_evals = stream(
                         n, m, quot_domain, pk.vk.k, beta, gamma, alpha,
                         alpha_sq_div_n, sel_h, sigma_h, wire_polys,
                         permutation_poly, pi_coeffs)
             else:
-                with tr.span("coset_ffts",
-                             polys=len(sel_h) + 2 * num_wire_types + 2):
+                with tr.span("coset_ffts", polys=n_coset_polys,
+                             flops=ntt_flops(m, n_coset_polys),
+                             data_bytes=n_coset_polys * m * 32):
                     # the 24 coset-FFTs go out as one batch (concurrent
                     # across the fleet / one device launch;
                     # dispatcher2.rs:382-423)
@@ -233,7 +247,8 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
                     del batch, selectors_coset, sigmas_coset, wires_coset
                     del z_coset, pi_coset
             if quot_evals is not None:
-                with tr.span("coset_ifft_quot"):
+                with tr.span("coset_ifft_quot", flops=ntt_flops(m),
+                             data_bytes=m * 32):
                     quotient_poly = backend.coset_ifft_h(quot_domain,
                                                          quot_evals)
 
@@ -244,7 +259,9 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
             # (reference src/dispatcher2.rs:511-525)
             split_quot_polys = backend.split(
                 quotient_poly, n + 2, num_wire_types, expected_degree + 1)
-            with tr.span("commit_quot", polys=len(split_quot_polys)):
+            with tr.span("commit_quot", polys=len(split_quot_polys),
+                         flops=msm_flops(n + 2, len(split_quot_polys)),
+                         data_bytes=len(split_quot_polys) * (n + 2) * 32):
                 split_quot_poly_comms = backend.commit_many_h(
                     ck, split_quot_polys)
         transcript.append_commitments(b"quot_poly_comms",
@@ -297,7 +314,8 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
         v = transcript.get_and_append_challenge(b"v")
 
         # batched opening at zeta: lin + wires + first 4 sigmas, powers of v
-        with tr.span("batch_open"):
+        with tr.span("batch_open", flops=msm_flops(n + 2, 2),
+                     data_bytes=2 * (n + 2) * 32):
             polys = [lin_poly] + wire_polys + sigma_h[:num_wire_types - 1]
             coeffs = []
             c = 1

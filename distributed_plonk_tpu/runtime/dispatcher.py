@@ -31,11 +31,14 @@ import time
 
 import numpy as np
 
+from contextlib import nullcontext
+
 from . import native, protocol
 from .faults import FaultInjector
 from .health import LivenessTracker, NullMetrics
 from .. import curve as C
 from ..backend.python_backend import PythonBackend
+from ..trace import merge_traces
 
 
 def _split_rc(n):
@@ -92,12 +95,16 @@ class WorkerHandle:
     BACKOFF_MAX_S = float(os.environ.get("DPT_BACKOFF_MAX_MS", "2000")) / 1e3
 
     def __init__(self, host, port, index=0, tracker=None, metrics=None,
-                 faults=None):
+                 faults=None, tracer=None):
         self.host, self.port = host, port
         self.index = index
         self.tracker = tracker
         self.metrics = metrics or NullMetrics()
         self.faults = faults
+        # tracer: when set, every call records an rpc span and injects
+        # its {trace_id, parent_id} into the frame (protocol.TRACED), so
+        # the worker's serve/kernel spans land in the same trace
+        self.tracer = tracer
         self.conn = None
         # one in-flight request per connection: frames are not interleavable
         self._lock = threading.Lock()
@@ -125,18 +132,33 @@ class WorkerHandle:
         with self._lock:
             self._drop_conn_locked()
 
-    def call(self, tag, payload=b""):
+    def call(self, tag, payload=b"", traced=True, parent=None):
         """Send one request; reconnect with backoff on transport failure.
         Raises WorkerUnavailable without dialing when the breaker is open
         (callers adopt the range / replan instead of burning a timeout),
         ConnectionError when every reconnect try failed, RuntimeError on
         an ERR reply (the worker is ALIVE — protocol errors don't count
-        against the breaker)."""
+        against the breaker). With a tracer armed, the call is recorded
+        as an rpc span and its context rides the frame (traced=False
+        opts a control call out, e.g. TRACE_DUMP itself); `parent` links
+        the span explicitly when the call runs on an executor thread
+        that cannot see the caller's span stack (the fleet fan-outs)."""
         if self.tracker is not None and not self.tracker.usable(self.index):
             raise WorkerUnavailable(f"worker {self.index} breaker open")
+        span = nullcontext() if self.tracer is None or not traced else \
+            self.tracer.span(f"rpc/{protocol.tag_name(tag).lower()}",
+                             parent=parent, worker=self.index,
+                             req_bytes=len(payload))
         try:
-            with self._lock:
-                rtag, rpayload = self._call_locked(tag, payload)
+            with span as span_sid, self._lock:
+                if span_sid is not None:
+                    # context computed once, outside the retry loop: a
+                    # reconnect resends the identical (idempotent) frame
+                    _, payload = protocol.wrap_traced(
+                        tag, payload, {"trace_id": self.tracer.trace_id,
+                                       "parent_id": span_sid})
+                rtag, rpayload = self._call_locked(
+                    tag, payload, traced=span_sid is not None)
         except (ConnectionError, OSError):
             if self.tracker is not None:
                 self.tracker.record_failure(self.index)
@@ -147,7 +169,7 @@ class WorkerHandle:
             raise RuntimeError(f"worker error: {rpayload!r}")
         return rpayload
 
-    def _call_locked(self, tag, payload):
+    def _call_locked(self, tag, payload, traced=False):
         delay = self.BACKOFF_BASE_S
         for attempt in range(self.RECONNECT_TRIES):
             try:
@@ -156,8 +178,12 @@ class WorkerHandle:
                 wire_tag = tag
                 if self.faults is not None:
                     # may sleep (delay), raise InjectedDrop (drop), scramble
-                    # the tag (corrupt), or kill the worker process (kill)
+                    # the tag (corrupt), or kill the worker process (kill).
+                    # Rules match on the BASE tag; the TRACED flag rides on
+                    # whatever tag the injector returns.
                     wire_tag = self.faults.on_send(self.index, tag, payload)
+                if traced:
+                    wire_tag |= protocol.TRACED
                 self.conn.send(wire_tag, payload)
                 return self.conn.recv()
             except (ConnectionError, OSError):
@@ -210,7 +236,7 @@ class Dispatcher:
 
     FFT_QUORUM = int(os.environ.get("DPT_FFT_QUORUM", "2"))
 
-    def __init__(self, config, metrics=None, faults=None):
+    def __init__(self, config, metrics=None, faults=None, tracer=None):
         self.metrics = metrics or NullMetrics()
         if faults is None:
             # env-driven chaos (DPT_FAULTS="drop:tag=NTT;delay:tag=MSM:ms=50")
@@ -218,11 +244,16 @@ class Dispatcher:
             # hot path stays injection-free
             faults = FaultInjector.from_env(metrics=self.metrics)
         self.faults = faults
+        # tracer: arms the distributed trace plane — every worker call
+        # becomes an rpc span carrying context over the wire, and
+        # collect_trace() stitches the workers' spans back into one
+        # offset-corrected timeline. None keeps the hot path span-free.
+        self.tracer = tracer
         self.tracker = LivenessTracker(len(config.workers),
                                        metrics=self.metrics)
         self.workers = [
             WorkerHandle(h, p, index=i, tracker=self.tracker,
-                         metrics=self.metrics, faults=faults)
+                         metrics=self.metrics, faults=faults, tracer=tracer)
             for i, (h, p) in enumerate(config.workers)]
         self.pool = futures.ThreadPoolExecutor(max_workers=len(self.workers))
         self._ranges = None
@@ -301,17 +332,19 @@ class Dispatcher:
         # a worker that is dead at provisioning time is tolerated: its
         # range stays unowned and the first msm() adopts it onto a healthy
         # worker through the same lazy-recovery path as a mid-prove death
-        results = self.pool.map(
-            lambda iw: _try(
-                lambda iw: iw[1].call(protocol.INIT_BASES,
-                                      protocol.encode_init_bases(
-                                          iw[0],
-                                          bases[self._ranges[iw[0]][0]:
-                                                self._ranges[iw[0]][1]])),
-                iw),
-            enumerate(self.workers))
-        if all(isinstance(r, _Failure) for r in results):
-            raise RuntimeError("no worker accepted its base range")
+        with self._span("fleet/init_bases", n=n) as prov_sid:
+            results = self.pool.map(
+                lambda iw: _try(
+                    lambda iw: iw[1].call(protocol.INIT_BASES,
+                                          protocol.encode_init_bases(
+                                              iw[0],
+                                              bases[self._ranges[iw[0]][0]:
+                                                    self._ranges[iw[0]][1]]),
+                                          parent=prov_sid),
+                    iw),
+                enumerate(self.workers))
+            if all(isinstance(r, _Failure) for r in results):
+                raise RuntimeError("no worker accepted its base range")
 
     def msm(self, scalars):
         """Distributed MSM with elastic recovery: scatter scalar ranges,
@@ -322,6 +355,13 @@ class Dispatcher:
         assert self._ranges is not None, "init_bases first"
         self._maybe_readmit()
 
+        # the fan-out runs on executor threads that cannot see this
+        # thread's span stack, so the fleet span's sid is threaded down
+        # explicitly — rpc spans stay children of fleet/msm in the tree
+        with self._span("fleet/msm", n=len(scalars)) as fleet_sid:
+            return self._msm_inner(scalars, fleet_sid)
+
+    def _msm_inner(self, scalars, fleet_sid=None):
         def part(i):
             start, end = self._ranges[i]
             chunk = scalars[start:end]
@@ -331,7 +371,8 @@ class Dispatcher:
             # re-dialing the dead worker, no re-upload
             w = self.workers[self._adopted.get(i, i)]
             raw = w.call(protocol.MSM,
-                         protocol.encode_msm_request(i, chunk))
+                         protocol.encode_msm_request(i, chunk),
+                         parent=fleet_sid)
             return protocol.decode_point(raw)
 
         total = None
@@ -346,11 +387,12 @@ class Dispatcher:
             # recoveries run concurrently; _recover_msm spreads adoptions
             # across the fleet starting at dead_i + 1
             for p in self.pool.map(
-                    lambda i: self._recover_msm(i, scalars), failed):
+                    lambda i: self._recover_msm(i, scalars, fleet_sid),
+                    failed):
                 total = C.g1_add_affine(total, p)
         return total
 
-    def _recover_msm(self, dead_i, scalars):
+    def _recover_msm(self, dead_i, scalars, fleet_sid=None):
         """Re-provision range dead_i's bases onto a healthy worker (set id
         unchanged — ids are ranges, not workers), recompute its part, and
         REMEMBER the adoption so later msm() calls route directly. Workers
@@ -370,9 +412,10 @@ class Dispatcher:
         def adopt(j):
             w = self.workers[j]
             w.call(protocol.INIT_BASES, protocol.encode_init_bases(
-                dead_i, self._bases[start:end]))
+                dead_i, self._bases[start:end]), parent=fleet_sid)
             raw = w.call(protocol.MSM,
-                         protocol.encode_msm_request(dead_i, chunk))
+                         protocol.encode_msm_request(dead_i, chunk),
+                         parent=fleet_sid)
             self._adopted[dead_i] = j
             self.metrics.inc("fleet_range_adoptions")
             return protocol.decode_point(raw)
@@ -424,19 +467,21 @@ class Dispatcher:
         self._maybe_readmit()
         rotation = [(worker + off) % k for off in range(k)]
         last_err = None
-        for i in [i for i in rotation if self.tracker.usable(i)]:
-            try:
-                raw = self.workers[i].call(protocol.NTT, payload)
-                return protocol.decode_scalars(raw)
-            except Exception as e:
-                last_err = e
-        for i in self._probe_readmit(
-                i for i in rotation if not self.tracker.usable(i)):
-            try:
-                raw = self.workers[i].call(protocol.NTT, payload)
-                return protocol.decode_scalars(raw)
-            except Exception as e:
-                last_err = e
+        with self._span("fleet/ntt", n=len(values), inverse=inverse,
+                        coset=coset):
+            for i in [i for i in rotation if self.tracker.usable(i)]:
+                try:
+                    raw = self.workers[i].call(protocol.NTT, payload)
+                    return protocol.decode_scalars(raw)
+                except Exception as e:
+                    last_err = e
+            for i in self._probe_readmit(
+                    i for i in rotation if not self.tracker.usable(i)):
+                try:
+                    raw = self.workers[i].call(protocol.NTT, payload)
+                    return protocol.decode_scalars(raw)
+                except Exception as e:
+                    last_err = e
         raise RuntimeError("no worker could serve the NTT") from last_err
 
     def ntt_many(self, jobs):
@@ -473,42 +518,50 @@ class Dispatcher:
         self._maybe_readmit()
         last_err = None
         same_set_retry = False
-        for _attempt in range(k + 1):
-            active = self.tracker.usable_set()
-            if len(active) < max(self.FFT_QUORUM, 1):
-                if len(active) < k:
-                    # a fault shrank the fleet below quorum; a CONFIGURED
-                    # sub-quorum fleet (k=1) taking this path is healthy
-                    # and must not read as continuous degradation
-                    self.metrics.inc("fleet_fft_degraded")
-                return self.ntt(values, inverse, coset)
-            try:
-                return self._fft_dist_attempt(values, inverse, coset, active)
-            except (FleetError, ConnectionError, OSError, RuntimeError) as e:
-                last_err = e
-                # attribute the loss: probe everyone, open breakers on the
-                # actually-dead, then replan on the survivors
-                self._probe_fleet()
-                if self.tracker.usable_set() == active:
-                    # nobody actually died: a transient (dropped/corrupt
-                    # frame, one slow call) gets ONE same-set retry; a
-                    # second failure on the unchanged set is a
-                    # deterministic error — surface it instead of burning
-                    # k+1 identical multi-second attempts
-                    if same_set_retry:
-                        raise
-                    same_set_retry = True
-                else:
-                    same_set_retry = False
-                self.metrics.inc("fleet_fft_replans")
+        with self._span("fleet/fft_dist", n=n, inverse=inverse,
+                        coset=coset) as fft_sid:
+            for _attempt in range(k + 1):
+                active = self.tracker.usable_set()
+                if len(active) < max(self.FFT_QUORUM, 1):
+                    if len(active) < k:
+                        # a fault shrank the fleet below quorum; a
+                        # CONFIGURED sub-quorum fleet (k=1) taking this
+                        # path is healthy and must not read as continuous
+                        # degradation
+                        self.metrics.inc("fleet_fft_degraded")
+                    return self.ntt(values, inverse, coset)
+                try:
+                    return self._fft_dist_attempt(values, inverse, coset,
+                                                  active, fft_sid)
+                except (FleetError, ConnectionError, OSError,
+                        RuntimeError) as e:
+                    last_err = e
+                    # attribute the loss: probe everyone, open breakers on
+                    # the actually-dead, then replan on the survivors
+                    self._probe_fleet()
+                    if self.tracker.usable_set() == active:
+                        # nobody actually died: a transient (dropped/
+                        # corrupt frame, one slow call) gets ONE same-set
+                        # retry; a second failure on the unchanged set is
+                        # a deterministic error — surface it instead of
+                        # burning k+1 identical multi-second attempts
+                        if same_set_retry:
+                            raise
+                        same_set_retry = True
+                    else:
+                        same_set_retry = False
+                    self.metrics.inc("fleet_fft_replans")
         raise RuntimeError(
             f"sharded FFT failed after {k + 1} replans") from last_err
 
-    def _fft_dist_attempt(self, values, inverse, coset, active):
+    def _fft_dist_attempt(self, values, inverse, coset, active,
+                          fft_sid=None):
         """One protocol run over the `active` worker subset. Dead workers
         keep zero-width row/column ranges, so the full-length col_ranges
         table still indexes by fleet position (peer routing is by config
-        index) while all data lands on the healthy subset."""
+        index) while all data lands on the healthy subset. The phase
+        fan-outs run on executor threads, so rpc spans link to the
+        fleet/fft_dist span through the explicit `fft_sid`."""
         n = len(values)
         r, c = _split_rc(n)
         k = len(self.workers)
@@ -538,7 +591,8 @@ class Dispatcher:
             lambda i: self.workers[i].call(
                 protocol.FFT_INIT, protocol.encode_fft_init(
                     task_id, inverse, coset, n, r, c,
-                    row_bounds[i][0], row_bounds[i][1], col_ranges)),
+                    row_bounds[i][0], row_bounds[i][1], col_ranges),
+                parent=fft_sid),
             active)
 
         def scatter(i):
@@ -547,14 +601,16 @@ class Dispatcher:
                 return
             panel = np.ascontiguousarray(rows_mat[:, rs:re, :])
             self.workers[i].call(
-                protocol.FFT1, protocol.encode_fft1_matrix(task_id, rs, panel))
+                protocol.FFT1, protocol.encode_fft1_matrix(task_id, rs, panel),
+                parent=fft_sid)
 
         run_phase(scatter, active)
 
         # trigger the all-to-all; each worker's OK implies its slices landed
         run_phase(
             lambda i: self.workers[i].call(
-                protocol.FFT2_PREPARE, struct.pack("<Q", task_id)),
+                protocol.FFT2_PREPARE, struct.pack("<Q", task_id),
+                parent=fft_sid),
             active)
 
         def gather(i):
@@ -562,7 +618,7 @@ class Dispatcher:
             if ce == cs:
                 return i, None
             flat = protocol.decode_scalar_matrix(self.workers[i].call(
-                protocol.FFT2, struct.pack("<Q", task_id)))
+                protocol.FFT2, struct.pack("<Q", task_id), parent=fft_sid))
             return i, flat
 
         out = np.empty((16, r, c), dtype=np.uint32)  # [16, k1, k2]
@@ -583,6 +639,53 @@ class Dispatcher:
         # result index is k1 + r*k2 -> transpose to [k2, k1] before flatten
         return protocol.matrix_to_ints(
             np.ascontiguousarray(out.transpose(0, 2, 1)).reshape(16, n))
+
+    # -- tracing --------------------------------------------------------------
+
+    def _span(self, name, **attrs):
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **attrs)
+
+    def estimate_offsets(self):
+        """Per-worker wall-clock offset estimates (seconds each worker's
+        clock runs AHEAD of ours), from the HEALTH probe round trip:
+        offset = worker_now - (t_send + t_recv)/2. Error is bounded by
+        half the round trip — microseconds on a LAN, far below the span
+        durations being aligned. Unreachable workers estimate 0.0."""
+        offsets = [0.0] * len(self.workers)
+        for i, w in enumerate(self.workers):
+            t0 = time.time()
+            snap = w.probe()
+            t1 = time.time()
+            if snap is not None and isinstance(snap.get("now"), (int, float)):
+                offsets[i] = snap["now"] - (t0 + t1) / 2.0  # analysis: ok(host-only clock math)
+        return offsets
+
+    def collect_trace(self):
+        """Stitch the distributed timeline for this dispatcher's trace:
+        our own spans + every worker's TRACE_DUMP for the trace id,
+        timestamps corrected by the per-worker clock-offset estimate.
+        Returns the merged dump (trace.merge_traces shape — store it as
+        a `trace:<job_id>` artifact via store.keycache.store_trace, or
+        export with trace.to_chrome_trace). None when no tracer armed.
+        Worker dumps are fetch-and-forget: collect once, at prove end."""
+        if self.tracer is None:
+            return None
+        dumps = [self.tracer.dump()]
+        offsets = [0.0]
+        est = self.estimate_offsets()
+        req = protocol.encode_json({"trace_id": self.tracer.trace_id})
+        for i, w in enumerate(self.workers):
+            try:
+                d = protocol.decode_json(
+                    w.call(protocol.TRACE_DUMP, req, traced=False))
+            except Exception:
+                continue  # dead/restarted worker: its spans are lost
+            if d.get("events"):
+                dumps.append(d)
+                offsets.append(est[i])
+        return merge_traces(dumps, offsets=offsets)
 
     # -- misc -----------------------------------------------------------------
 

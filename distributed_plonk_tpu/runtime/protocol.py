@@ -66,12 +66,60 @@ STORE_FETCH = 26   # JSON {key} -> OK + [u32 hdr][hdr JSON {key, digest,
                    # network copy instead of a rebuild (store/remote.py
                    # re-verifies the digest client-side). Served by the
                    # proof service and by runtime workers given --store.
+TRACE_DUMP = 27    # JSON {trace_id} -> OK + JSON tracer dump ({} when
+                   # the worker holds no spans for that id): fetch-and-
+                   # forget one trace's worker-side spans so the
+                   # dispatcher can stitch them into the merged per-job
+                   # timeline (trace.merge_traces, offset-corrected
+                   # against the HEALTH clock sample)
 OK = 100
 ERR = 101
+
+# TRACED is a tag FLAG, not a tag: a sender that wants its trace context
+# to ride a frame ORs it into the tag and prefixes the payload with
+# [u16 ctx_len][ctx JSON {trace_id, parent_id?}] (wrap_traced). Receivers
+# call strip_context() first, which passes flag-less frames through
+# untouched — an old client's frames parse exactly as before, and a
+# traced frame to an old receiver fails loudly (unknown tag), never
+# silently misparses. Kept clear of the chaos injector's corruption bit
+# (runtime/faults.py XORs 0x40000000).
+TRACED = 0x10000
 
 FR_BYTES = 32
 FQ_BYTES = 48
 POINT_BYTES = 2 * FQ_BYTES + 1
+
+# tag value -> name, for span labels and diagnostics (flag bits and
+# non-tag constants excluded: tags live in [1, 101])
+TAG_NAMES = {value: name for name, value in list(globals().items())
+             if name.isupper() and isinstance(value, int)
+             and 0 < value <= ERR
+             and name not in ("FR_BYTES", "FQ_BYTES", "POINT_BYTES")}
+
+
+def tag_name(tag):
+    return TAG_NAMES.get(tag & ~TRACED, str(tag))
+
+
+# --- trace-context framing ---------------------------------------------------
+
+def wrap_traced(tag, payload, ctx):
+    """(tag | TRACED, context-prefixed payload) — attach a trace context
+    (trace.Tracer.context() dict) to one frame. No-op when ctx is None."""
+    if not ctx:
+        return tag, payload
+    raw = encode_json(ctx)
+    return tag | TRACED, struct.pack("<H", len(raw)) + raw + payload
+
+
+def strip_context(tag, payload):
+    """(base_tag, ctx | None, payload) — inverse of wrap_traced. Frames
+    without the TRACED flag (every pre-trace client) pass through
+    untouched, so the framing stays back-compatible."""
+    if not tag & TRACED:
+        return tag, None, payload
+    (clen,) = struct.unpack_from("<H", payload, 0)
+    return tag & ~TRACED, decode_json(payload[2:2 + clen]), payload[2 + clen:]
 
 
 def encode_scalars(scalars):

@@ -30,6 +30,7 @@ import struct
 import sys
 import threading
 import time
+from collections import OrderedDict
 
 import numpy as np
 
@@ -38,6 +39,12 @@ from .netconfig import NetworkConfig
 from ..constants import R_MOD, FR_GENERATOR
 from ..fields import fr_inv, fr_root_of_unity
 from ..poly import Domain
+from ..trace import NULL_TRACER, Tracer, msm_flops, ntt_flops
+
+# resident per-trace span buffers: the dispatcher fetches-and-forgets
+# them via TRACE_DUMP, but a dispatcher that dies mid-prove must not
+# leak its trace buffers forever — LRU cap, oldest trace dropped
+_TRACE_CAP = int(os.environ.get("DPT_WORKER_TRACE_CAP", "32"))
 
 
 def _make_backend(name):
@@ -97,6 +104,9 @@ class WorkerState:
         self.peers = {}
         self.peer_lock = threading.Lock()
         self.counters = {}
+        # trace_id -> Tracer holding this worker's spans for that trace
+        # (shipped back + forgotten on TRACE_DUMP; LRU-capped)
+        self.traces = OrderedDict()
         # jax workers run whole FFT1/FFT2 frames as single batched device
         # launches over limb panels (no per-row dispatch, no host ints)
         if getattr(backend, "name", "") == "jax":
@@ -113,6 +123,27 @@ class WorkerState:
     def count(self, tag):
         with self.lock:
             self.counters[tag] = self.counters.get(tag, 0) + 1
+
+    def tracer_for(self, ctx):
+        """The per-trace Tracer an incoming traced frame records under
+        (created on first sight of the trace id, LRU past _TRACE_CAP)."""
+        tid = ctx.get("trace_id") if isinstance(ctx, dict) else None
+        if not tid:
+            return NULL_TRACER
+        with self.lock:
+            tr = self.traces.get(tid)
+            if tr is None:
+                tr = self.traces[tid] = Tracer(
+                    trace_id=tid, proc=f"worker/{self.me}")
+                while len(self.traces) > _TRACE_CAP:
+                    self.traces.popitem(last=False)
+            else:
+                self.traces.move_to_end(tid)
+            return tr
+
+    def pop_trace(self, trace_id):
+        with self.lock:
+            return self.traces.pop(trace_id, None)
 
     def peer(self, p):
         """Lazy worker->worker connection (the reference opens peer
@@ -207,7 +238,16 @@ def handle(conn, state):
         except ConnectionError:
             return True
         try:
-            cont = _dispatch(conn, state, tag, payload)
+            # trace-context framing: a TRACED frame carries the caller's
+            # {trace_id, parent_id}; the request is served under a span in
+            # that trace's buffer (shipped back via TRACE_DUMP). Untraced
+            # frames take the identical path with the null tracer.
+            tag, ctx, payload = protocol.strip_context(tag, payload)
+            tracer = state.tracer_for(ctx) if ctx is not None else NULL_TRACER
+            parent = ctx.get("parent_id") if ctx else None
+            with tracer.span("serve/" + protocol.tag_name(tag).lower(),
+                             parent=parent, req_bytes=len(payload)):
+                cont = _dispatch(conn, state, tag, payload, tracer=tracer)
         except Exception as e:  # malformed payload / backend failure
             try:
                 conn.send(protocol.ERR, repr(e).encode())
@@ -254,7 +294,7 @@ def _evict_fft_tasks(tasks, cap, now):
         del tasks[tid]
 
 
-def _dispatch(conn, state, tag, payload):
+def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
     """Handle one request frame. Returns False to stop the daemon, anything
     else to keep serving.
 
@@ -277,20 +317,29 @@ def _dispatch(conn, state, tag, payload):
         if bases is None:
             conn.send(protocol.ERR, b"no bases for set %d" % set_id)
             return None
-        result = state.backend.msm(bases, scalars)
+        # kernel span attrs carry the bench.py flops/bytes model so the
+        # merged timeline (and the MFU gauges fed from it) can attribute
+        # where device time went, not just that it went
+        with tracer.span("msm", n=len(scalars),
+                         flops=msm_flops(len(scalars)),
+                         data_bytes=len(scalars) * protocol.FR_BYTES):
+            result = state.backend.msm(bases, scalars)
         conn.send(protocol.OK, protocol.encode_point(result))
     elif tag == protocol.NTT:
         values, inverse, coset = protocol.decode_ntt_request(payload)
         with state.lock:
             domain = state.domain(len(values))
-        if inverse and coset:
-            out = state.backend.coset_ifft(domain, values)
-        elif inverse:
-            out = state.backend.ifft(domain, values)
-        elif coset:
-            out = state.backend.coset_fft(domain, values)
-        else:
-            out = state.backend.fft(domain, values)
+        with tracer.span("ntt", n=len(values), inverse=inverse, coset=coset,
+                         flops=ntt_flops(len(values)),
+                         data_bytes=len(values) * protocol.FR_BYTES):
+            if inverse and coset:
+                out = state.backend.coset_ifft(domain, values)
+            elif inverse:
+                out = state.backend.ifft(domain, values)
+            elif coset:
+                out = state.backend.coset_fft(domain, values)
+            else:
+                out = state.backend.fft(domain, values)
         conn.send(protocol.OK,
                   protocol.encode_scalar_matrix(protocol.ints_to_matrix(out)))
     elif tag == protocol.FFT_INIT:
@@ -307,26 +356,29 @@ def _dispatch(conn, state, tag, payload):
         with state.lock:
             task = state.fft_tasks[task_id]
         count = panel.shape[1]
-        if state.stages is not None:
-            staged = state.stages.stage1_panel(task, first_row, panel)
-            lo = first_row - task.rs
-            with task.cols_lock:
-                if task.rows_mat is None:
-                    task.rows_mat = np.zeros(
-                        (16, task.re - task.rs, task.r), dtype=np.uint32)
-                task.rows_mat[:, lo:lo + count, :] = staged
-                task.rows_filled[lo:lo + count] = True
-        else:
-            with state.lock:
-                domain_r = state.domain(task.r)
-            ints = protocol.matrix_to_ints(
-                panel.reshape(16, count * panel.shape[2]))
-            row_len = panel.shape[2]
-            for off in range(count):
-                j2 = first_row + off
-                task.rows[j2 - task.rs] = _stage1_row(
-                    state.backend, domain_r, task, j2,
-                    ints[off * row_len:(off + 1) * row_len])
+        with tracer.span("fft1_rows", rows=count, r=task.r,
+                         flops=ntt_flops(task.r, count),
+                         data_bytes=count * task.r * protocol.FR_BYTES):
+            if state.stages is not None:
+                staged = state.stages.stage1_panel(task, first_row, panel)
+                lo = first_row - task.rs
+                with task.cols_lock:
+                    if task.rows_mat is None:
+                        task.rows_mat = np.zeros(
+                            (16, task.re - task.rs, task.r), dtype=np.uint32)
+                    task.rows_mat[:, lo:lo + count, :] = staged
+                    task.rows_filled[lo:lo + count] = True
+            else:
+                with state.lock:
+                    domain_r = state.domain(task.r)
+                ints = protocol.matrix_to_ints(
+                    panel.reshape(16, count * panel.shape[2]))
+                row_len = panel.shape[2]
+                for off in range(count):
+                    j2 = first_row + off
+                    task.rows[j2 - task.rs] = _stage1_row(
+                        state.backend, domain_r, task, j2,
+                        ints[off * row_len:(off + 1) * row_len])
         conn.send(protocol.OK)
     elif tag == protocol.FFT2_PREPARE:
         (task_id,) = struct.unpack_from("<Q", payload, 0)
@@ -350,18 +402,29 @@ def _dispatch(conn, state, tag, payload):
                         for v in task.rows[j2 - task.rs]]
                 rows_np = protocol.ints_to_matrix(flat).reshape(
                     16, task.re - task.rs, task.r)
-            for p, (ps, pe) in enumerate(task.col_ranges):
-                if pe == ps:
-                    continue
-                panel = np.ascontiguousarray(rows_np[:, :, ps:pe])
-                # peer_call retries once on a fresh stream: a peer that
-                # restarted since the last FFT invalidates the cached conn
-                rtag, rpayload = state.peer_call(
-                    p, protocol.FFT_EXCHANGE,
-                    protocol.encode_fft_exchange(
-                        task_id, ps, pe - ps, task.rs, panel))
-                if rtag != protocol.OK:
-                    raise RuntimeError(f"peer {p} exchange failed: {rpayload!r}")
+            # the all-to-all is worker->worker: re-inject our trace
+            # context into each peer frame so the receiving workers'
+            # exchange spans land in the SAME trace (peer legs would
+            # otherwise be invisible to the merged timeline)
+            with tracer.span("fft_exchange_push") as push_sid:
+                for p, (ps, pe) in enumerate(task.col_ranges):
+                    if pe == ps:
+                        continue
+                    panel = np.ascontiguousarray(rows_np[:, :, ps:pe])
+                    xtag, xpayload = protocol.FFT_EXCHANGE, \
+                        protocol.encode_fft_exchange(
+                            task_id, ps, pe - ps, task.rs, panel)
+                    if push_sid is not None:
+                        xtag, xpayload = protocol.wrap_traced(
+                            xtag, xpayload, {"trace_id": tracer.trace_id,
+                                             "parent_id": push_sid})
+                    # peer_call retries once on a fresh stream: a peer that
+                    # restarted since the last FFT invalidates the cached
+                    # conn
+                    rtag, rpayload = state.peer_call(p, xtag, xpayload)
+                    if rtag != protocol.OK:
+                        raise RuntimeError(
+                            f"peer {p} exchange failed: {rpayload!r}")
         conn.send(protocol.OK)
     elif tag == protocol.FFT_EXCHANGE:
         task_id, col_start, col_count, row_start, panel = \
@@ -385,19 +448,22 @@ def _dispatch(conn, state, tag, payload):
             assert task.fill_mask.all(), \
                 f"fft2 before exchange complete ({task.fill_mask.sum()}" \
                 f"/{task.fill_mask.size})"
-            if state.stages is not None and task.ce > task.cs:
-                staged = state.stages.stage2_panel(task, task.cols)
-                task.result = protocol.encode_scalar_matrix(
-                    staged.reshape(16, staged.shape[1] * staged.shape[2]))
-            else:
-                out = []
-                for local, k1 in enumerate(range(task.cs, task.ce)):
-                    row = protocol.matrix_to_ints(task.cols[:, local, :])
-                    out.extend(
-                        _stage2_row(state.backend, domain_c, task, k1, row))
-                # reply rides the bulk codec (wire-identical path)
-                task.result = protocol.encode_scalar_matrix(
-                    protocol.ints_to_matrix(out))
+            with tracer.span("fft2_cols", cols=task.ce - task.cs, c=task.c,
+                             flops=ntt_flops(task.c, task.ce - task.cs)):
+                if state.stages is not None and task.ce > task.cs:
+                    staged = state.stages.stage2_panel(task, task.cols)
+                    task.result = protocol.encode_scalar_matrix(
+                        staged.reshape(16,
+                                       staged.shape[1] * staged.shape[2]))
+                else:
+                    out = []
+                    for local, k1 in enumerate(range(task.cs, task.ce)):
+                        row = protocol.matrix_to_ints(task.cols[:, local, :])
+                        out.extend(_stage2_row(state.backend, domain_c,
+                                               task, k1, row))
+                    # reply rides the bulk codec (wire-identical path)
+                    task.result = protocol.encode_scalar_matrix(
+                        protocol.ints_to_matrix(out))
             task.done_at = time.monotonic()
         conn.send(protocol.OK, task.result)
     elif tag == protocol.STATS:
@@ -417,8 +483,24 @@ def _dispatch(conn, state, tag, payload):
                 "fft_tasks": len(state.fft_tasks),
                 "base_sets": sorted(state.base_sets),
                 "backend": getattr(state.backend, "name", "?"),
+                # wall-clock sample: the dispatcher brackets the probe
+                # with its own clock and estimates this worker's offset
+                # as now - (t_send + t_recv)/2, NTP-style — how merged
+                # trace timestamps get onto one timeline
+                "now": time.time(),
+                "traces": len(state.traces),
             }
         conn.send(protocol.OK, _json.dumps(snap).encode())
+    elif tag == protocol.TRACE_DUMP:
+        # fetch-and-forget one trace's worker-side spans: the dispatcher
+        # stitches them (offset-corrected) into the merged per-job
+        # timeline; an unknown id answers {} (the worker may have been
+        # restarted, or LRU-dropped an abandoned trace)
+        import json as _json
+        req = protocol.decode_json(payload)
+        tr = state.pop_trace(req.get("trace_id"))
+        conn.send(protocol.OK,
+                  _json.dumps(tr.dump() if tr is not None else {}).encode())
     elif tag == protocol.STORE_FETCH:
         # peer-serving plane: a replacement worker on a fresh host pulls
         # SRS/pk/checkpoint blobs from us instead of rebuilding them

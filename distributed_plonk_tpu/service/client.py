@@ -43,8 +43,15 @@ class ServiceClient:
     def ping(self):
         self._call(protocol.PING)
 
-    def submit(self, spec):
-        """spec: JSON-able job dict -> SUBMIT reply dict ({job_id, ...})."""
+    def submit(self, spec, trace_ctx=None):
+        """spec: JSON-able job dict -> SUBMIT reply dict ({job_id, ...,
+        trace_id}). trace_ctx (a trace.Tracer.context() dict) makes the
+        server ADOPT the client's trace id instead of stamping a fresh
+        one, so the job's merged timeline links back to the caller's
+        span — one trace from the client through the last worker
+        kernel."""
+        if trace_ctx:
+            spec = dict(spec, trace_ctx=trace_ctx)
         return protocol.decode_json(
             self._call(protocol.SUBMIT, protocol.encode_json(spec)))
 
@@ -81,6 +88,15 @@ class ServiceClient:
         return protocol.decode_result(
             self._call(protocol.STORE_FETCH,
                        protocol.encode_json({"key": key})))
+
+    def trace(self, job_id):
+        """The job's merged distributed timeline (the trace:<job_id>
+        store artifact) as a dict. Raises ServiceError when the server
+        is storeless or the trace is gone; `serve.py --obs-port`'s
+        /trace/<job_id> serves the same bytes over HTTP."""
+        import json
+        _hdr, blob = self.store_fetch(f"trace:{job_id}")
+        return json.loads(blob.decode())
 
     def kill_worker(self, worker=None, job_id=None, at_round=None):
         req = {}

@@ -31,6 +31,7 @@ import time
 
 from ..circuit import PlonkCircuit
 from ..constants import R_MOD
+from ..trace import new_trace_id
 
 # same deterministic toxic-waste tau as tests/conftest.py's fixture SRS:
 # server and clients derive identical keys from a spec alone
@@ -205,8 +206,15 @@ class Job:
         # expired during the outage is shed, not resumed)
         self.deadline_ts = (time.time() + spec.ttl_s
                             if spec.ttl_s is not None else None)
+        # every job IS one trace: the id is stamped here (or adopted from
+        # the client's trace_ctx by the frontend), handed to the prover
+        # tracer, and addresses the merged-timeline artifact trace:<id>
+        self.trace_id = new_trace_id()
+        self.trace_parent = None    # client-side parent span, if adopted
+        self.trace_dump = None      # merged timeline (set at finish_ok)
         self.state = QUEUED
         self.submitted_at = time.monotonic()
+        self.submitted_wall = time.time()   # anchors the queue-wait span
         self.scheduled_at = None
         self.started_at = None
         self.finished_at = None
@@ -270,6 +278,9 @@ class Job:
         return {
             "job_id": self.id,
             "state": self.state,
+            "trace_id": self.trace_id,
+            "trace_spans": (len(self.trace_dump.get("events") or [])
+                            if self.trace_dump else None),
             "spec": self.spec.to_wire(),
             "shape_key": [str(p) for p in self.shape_key],
             "priority": self.priority,

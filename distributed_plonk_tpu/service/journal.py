@@ -184,6 +184,11 @@ class JobJournal:
                 "spec": rec.get("spec"), "key": rec.get("key"),
                 "deadline": rec.get("deadline"),
                 "submitted": rec.get("ts"),
+                # trace identity survives a restart: the client was told
+                # this id at SUBMIT, so the recovered job (and its
+                # trace:<job_id> artifact) must keep answering to it
+                "trace": rec.get("trace"),
+                "trace_parent": rec.get("trace_parent"),
                 "phase": "submit", "round": 0, "worker": None,
                 "done": None, "reason": None,
             }
@@ -265,8 +270,13 @@ class JobJournal:
     @staticmethod
     def _state_records(jid, st):
         """Minimal record sequence that replays back to `st`."""
-        yield {"t": SUBMIT, "id": jid, "spec": st["spec"], "key": st["key"],
-               "deadline": st["deadline"], "ts": st["submitted"]}
+        sub = {"t": SUBMIT, "id": jid, "spec": st["spec"],
+               "key": st["key"], "deadline": st["deadline"],
+               "ts": st["submitted"]}
+        for k in ("trace", "trace_parent"):
+            if st.get(k) is not None:
+                sub[k] = st[k]
+        yield sub
         if st["round"]:
             yield {"t": ROUND, "id": jid, "round": st["round"]}
         if st["phase"] == "done":

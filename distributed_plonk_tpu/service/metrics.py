@@ -1,21 +1,71 @@
-"""Service observability: counters, gauges, and latency histograms.
+"""Service observability: counters, gauges, latency histograms, exposition.
 
 The structured upgrade of the worker plane's raw `{tag: count}` STATS
 counters (runtime/worker.py) for the serving layer: one `Metrics` registry
 aggregates queue depth, wait/run latencies, per-prover-round times (fed
-from trace.Tracer totals), retries/kills, and throughput, and snapshots to
-one JSON-able dict for the METRICS wire tag.
+from trace.Tracer totals), retries/kills, and throughput, snapshots to one
+JSON-able dict for the METRICS wire tag, and renders the Prometheus text
+exposition (`to_prometheus`) that serve.py --obs-port serves at /metrics.
 
 Histograms keep a bounded reservoir (uniform sampling past the cap, so
 long runs stay O(1) memory) and report count/sum/min/mean/percentiles
-computed from the reservoir at snapshot time.
+computed from the reservoir at snapshot time; `samples` says how many
+reservoir values back the percentile estimates (past the cap they are
+estimates over a uniform sample, not exact order statistics).
+
+METRIC GLOSSARY — every counter/histogram name the code records must be
+documented here; analysis/lint.py's OBS01 lint enforces it (a `_*`
+suffix documents a name family). Scoped registries (Metrics.scoped)
+publish under their prefix: the artifact store's entries appear as
+store_<name>.
+
+Job lifecycle (service/server.py, service/pool.py, service/queue.py):
+    jobs_submitted / jobs_accepted / jobs_rejected   admission outcomes
+    jobs_completed / jobs_failed / jobs_timeout      terminal outcomes
+    job_retries / job_attempt_errors                 retry-loop activity
+    jobs_evicted                                     finished jobs aged out
+                                                     of the job table
+    workers_spawned / workers_killed / kill_requests  pool slot lifecycle
+                                                     + fault injection
+    warmups                                          WARMUP requests served
+    job_wait / job_run (histograms)                  submit->start and
+                                                     start->done seconds
+    prove_round/* (histograms)                       per-round prover
+                                                     latency (trace totals)
+    queue_depth / queue_high_water (gauges)          admission backlog
+
+Scheduler + shape buckets (service/scheduler.py):
+    batches_dispatched / batch_size                  shape-batch activity
+    dispatch_errors                                  pool handoff failures
+    bucket_hits / bucket_misses / bucket_disk_hits   key-cache tiers
+    bucket_peer_hits                                 keys fetched from a
+                                                     warm STORE_FETCH peer
+    bucket_latch_waits                               callers that waited on
+                                                     another thread's
+                                                     in-flight key setup
+    bucket_mem_evictions / buckets_resident (gauge)  memory-tier LRU
+    bucket_build / bucket_disk_load (histograms)     tier latencies
+    bucket_build_errors                              key builds that failed
+    store_write_errors                               best-effort artifact
+                                                     writes that failed
+
+Artifact store, scoped `store_*` (store/artifacts.py, store/remote.py):
+    store_hits / store_misses / store_evictions      blob cache activity
+    store_corrupt                                    integrity failures on
+                                                     read (entry deleted,
+                                                     rebuilt on demand)
+    store_entries / store_bytes (gauges)             resident inventory
+    store_put_bytes                                  bytes written
+    store_jax_cache_bytes / store_jax_cache_evictions  compile-cache GC
+    store_fetch_served / store_fetch_misses          STORE_FETCH server side
+    store_fetch_bytes                                blob bytes served
 
 Failure-observability vocabulary (one registry can be handed to the
 runtime Dispatcher AND the service pool, so a whole deployment's fault
 story reads off one snapshot):
     fleet_reconnects / fleet_backoff_waits   reconnect loop activity
     fleet_backoff (histogram)                seconds slept in backoff
-    fleet_breaker_opens / fleet_readmissions circuit-breaker transitions
+    fleet_breaker_opens / fleet_readmissions  circuit-breaker transitions
     fleet_range_adoptions                    MSM ranges moved off a dead
                                              worker (runtime dispatcher)
     fleet_fft_replans / fleet_fft_degraded   sharded-FFT recovery events
@@ -30,7 +80,7 @@ Durability vocabulary (service/journal.py + the restart-recovery path):
                                              at open
     journal_torn_records / journal_compactions  damaged-tail truncations
                                              and log rewrites
-    jobs_recovered / jobs_recovered_finished re-enqueued in-flight jobs
+    jobs_recovered / jobs_recovered_finished  re-enqueued in-flight jobs
                                              and artifact-served DONE
                                              jobs after a restart
     jobs_shed                                TTL/deadline load-shed
@@ -43,13 +93,34 @@ Durability vocabulary (service/journal.py + the restart-recovery path):
     proof_artifacts_lost                     DONE records whose proof
                                              artifact was evicted (job
                                              re-proved, same bytes)
+
+Tracing vocabulary (trace.py, service/pool.py, server.py --obs-port):
+    trace_spans_recorded                     spans folded into finished
+                                             jobs' merged timelines
+    traces_stored                            trace:<job_id> artifacts
+                                             written to the store
+    obs_http_requests                        /metrics /healthz /trace
+                                             requests served
+    kernel_*_gflops / mfu_*_pct (gauges)     live per-stage throughput
+                                             and model-flops MFU from
+                                             kernel span attrs (peak set
+                                             by DPT_PEAK_TFLOPS)
 """
 
+import math
+import os
 import random
+import re
 import threading
 import time
 
 _RESERVOIR = 2048
+
+# MFU denominator: the chip's peak f32 FMA rate in TFLOP/s (bench.py's
+# f32_fma_tflops_measured is the number to use). The default 1.0 makes
+# mfu_*_pct read as GFLOP/s / 10 until an operator calibrates it — a
+# consistent relative signal either way.
+PEAK_TFLOPS = float(os.environ.get("DPT_PEAK_TFLOPS", "1.0"))
 
 
 class Histogram:
@@ -80,10 +151,17 @@ class Histogram:
         s = sorted(self._samples)
 
         def pct(p):
-            return s[min(len(s) - 1, int(p * len(s)))]
+            # nearest-rank percentile over the reservoir: ceil(p*k)-1,
+            # clamped for tiny counts (the old int(p*k) indexed the MAX
+            # for any p >= 1-1/k — e.g. a 2-sample p50 returned the max)
+            return s[max(0, min(len(s) - 1, math.ceil(p * len(s)) - 1))]
 
         return {
             "count": self.count,
+            # percentiles below are computed over `samples` retained
+            # reservoir values, not all `count` observations — estimates,
+            # not exact order statistics, once samples < count
+            "samples": len(s),
             "sum_s": round(self.sum, 6),
             "min_s": round(self.min, 6),
             "mean_s": round(self.sum / self.count, 6),
@@ -92,6 +170,11 @@ class Histogram:
             "p99_s": round(pct(0.99), 6),
             "max_s": round(self.max, 6),
         }
+
+
+def _prom_name(name):
+    """Metric name -> Prometheus-legal name under the dpt_ namespace."""
+    return "dpt_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
 class Metrics:
@@ -130,6 +213,27 @@ class Metrics:
         for span, dur in totals.items():
             self.observe(f"prove_round/{span}", dur)
 
+    def observe_kernels(self, events, peak_tflops=None):
+        """Fold kernel spans carrying `flops` attrs (trace.Tracer events
+        of a finished prove — see prover.py / trace.ntt_flops) into live
+        per-stage gauges: kernel_<stage>_gflops (model-flops throughput)
+        and mfu_<stage>_pct (against DPT_PEAK_TFLOPS). The serving-path
+        counterpart of bench.py's one-shot MFU numbers."""
+        peak = (peak_tflops if peak_tflops is not None else PEAK_TFLOPS) \
+            * 1e12
+        for ev in events:
+            flops = ev.get("flops")
+            dur = ev.get("dur_s")
+            if not flops or not dur:
+                continue
+            stage = re.sub(r"[^a-zA-Z0-9_]", "_",
+                           ev["span"].rsplit("/", 1)[-1])
+            self.gauge(f"kernel_{stage}_gflops",
+                       round(flops / dur / 1e9, 3))
+            if peak > 0:
+                self.gauge(f"mfu_{stage}_pct",
+                           round(100.0 * flops / (dur * peak), 4))
+
     def snapshot(self):
         with self._lock:
             done = self._counters.get("jobs_completed", 0)
@@ -142,6 +246,45 @@ class Metrics:
                                for k, h in sorted(self._hists.items())},
                 "throughput_jobs_per_s": round(done / uptime, 6) if uptime else 0.0,
             }
+
+    def to_prometheus(self, extra_gauges=None):
+        """Prometheus text exposition (format version 0.0.4) of the
+        current snapshot: counters as `dpt_<name>_total`, gauges as
+        `dpt_<name>`, histograms as summaries (`{quantile=...}` series
+        from the reservoir percentiles, plus _sum/_count and a _samples
+        gauge for the reservoir size). `extra_gauges` lets the caller
+        splice in point-in-time values (queue depth) the registry does
+        not own."""
+        snap = self.snapshot()
+        gauges = dict(snap["gauges"])
+        if extra_gauges:
+            gauges.update(extra_gauges)
+        gauges["uptime_s"] = snap["uptime_s"]
+        gauges["throughput_jobs_per_s"] = snap["throughput_jobs_per_s"]
+        lines = []
+        for name, v in sorted(snap["counters"].items()):
+            n = _prom_name(name) + "_total"
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n} {v}")
+        for name, v in sorted(gauges.items()):
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue  # non-numeric gauge (labels) — JSON snapshot only
+            n = _prom_name(name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {v}")
+        for name, h in sorted(snap["histograms"].items()):
+            if not h.get("count"):
+                continue
+            n = _prom_name(name) + "_seconds"
+            lines.append(f"# TYPE {n} summary")
+            for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
+                           ("0.99", "p99_s")):
+                lines.append(f'{n}{{quantile="{q}"}} {h[key]}')
+            lines.append(f"{n}_sum {h['sum_s']}")
+            lines.append(f"{n}_count {h['count']}")
+            lines.append(f"# TYPE {n}_samples gauge")
+            lines.append(f"{n}_samples {h['samples']}")
+        return "\n".join(lines) + "\n"
 
 
 class _Scoped:

@@ -404,7 +404,16 @@ class WorkerPool:
         if self.job_timeout_s is not None:
             worker.deadline = job.started_at + self.job_timeout_s
         try:
-            tracer = Tracer()
+            # the prover traces under the JOB's id (stamped/adopted at
+            # SUBMIT), parented to the client's span when one was
+            # propagated — every retry attempt re-records from scratch,
+            # so the stored timeline is the attempt that produced the
+            # proof plus the queue wait that preceded it
+            tracer = Tracer(trace_id=job.trace_id,
+                            parent_id=job.trace_parent,
+                            proc=f"pool/{worker.name}")
+            tracer.add_event("service/queued", ts=job.submitted_wall,
+                             dur_s=job.wait_s, job_id=job.id)
             ckt = J.build_circuit(job.spec)
             guard = self._make_guard(job, worker)
             try:
@@ -424,12 +433,37 @@ class WorkerPool:
                     "proof failed server-side verification"
             totals = tracer.totals(depth=1)
             self.metrics.observe_rounds(totals)
+            # kernel spans carry flops attrs (prover.py): fold them into
+            # live per-stage MFU/throughput gauges — the serving-path
+            # replacement for bench-only MFU numbers
+            self.metrics.observe_kernels(tracer.events)
             proof_bytes = serialize_proof(proof)
             pub = ckt.public_input()
             self._journal_done(job, proof_bytes, pub)
+            self._store_trace(job, tracer)
             job.finish_ok(proof_bytes, pub, totals)
         finally:
             worker.deadline = None
+
+    def _store_trace(self, job, tracer):
+        """Merge + persist the job's timeline: always retained on the Job
+        (STATUS reports trace_spans; /trace serves it), and — with a
+        store — written as the content-addressed `trace:<job_id>`
+        artifact (STORE_FETCHable, like the proof it explains).
+        Observability is best-effort: failure to persist never fails a
+        finished prove."""
+        from ..trace import merge_traces
+        merged = merge_traces([tracer.dump()])
+        job.trace_dump = merged
+        self.metrics.inc("trace_spans_recorded", len(merged["events"]))
+        if self.store is None:
+            return
+        from ..store import keycache as KC
+        try:
+            KC.store_trace(self.store, job.id, merged)
+            self.metrics.inc("traces_stored")
+        except Exception:  # pragma: no cover - environmental (disk)
+            self.metrics.inc("store_write_errors")
 
     def _journal_done(self, job, proof_bytes, pub):
         """Finished-proof durability, BEFORE the client-visible state

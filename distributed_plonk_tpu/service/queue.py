@@ -71,6 +71,11 @@ class JobQueue:
             self._items = rest
             return batch
 
+    def closed(self):
+        """True once close() ran (draining) — /healthz reports it."""
+        with self._lock:
+            return self._closed
+
     def close(self):
         """Stop admitting; wake any blocked pop."""
         with self._lock:

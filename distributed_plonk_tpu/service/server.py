@@ -124,6 +124,20 @@ class ProofService:
         service restart)."""
         spec = JobSpec.from_wire(spec_obj)
         job = Job(spec)
+        # distributed tracing: adopt the client's trace context when the
+        # SUBMIT payload carries one (trace_ctx rides beside the spec
+        # fields; it changes nothing about the circuit), else the fresh
+        # id Job() stamped stands — either way every job has exactly one
+        # trace id from admission to the last worker kernel
+        ctx = spec_obj.get("trace_ctx") if isinstance(spec_obj, dict) \
+            else None
+        if isinstance(ctx, dict):
+            tid = ctx.get("trace_id")
+            if isinstance(tid, str) and tid:
+                job.trace_id = tid
+            parent = ctx.get("parent_id")
+            if isinstance(parent, str) and parent:
+                job.trace_parent = parent
         with self._submit_lock:
             with self._jobs_lock:
                 if spec.job_key is not None:
@@ -143,6 +157,8 @@ class ProofService:
                 self.journal.append(JN.SUBMIT, job.id, spec=spec.to_wire(),
                                     key=spec.job_key,
                                     deadline=job.deadline_ts,
+                                    trace=job.trace_id,
+                                    trace_parent=job.trace_parent,
                                     ts=time.time())
             try:
                 self.queue.submit(job)
@@ -245,6 +261,12 @@ class ProofService:
             # the deadline is the ORIGINAL submission's, not re-derived
             # from recovery time — a restart must not extend any TTL
             job.deadline_ts = st.get("deadline")
+            # ...and so is the trace identity: the SUBMIT reply already
+            # told the client this id; re-stamping would orphan the
+            # client's spans from the recovered job's timeline
+            if st.get("trace"):
+                job.trace_id = st["trace"]
+                job.trace_parent = st.get("trace_parent")
             phase = st["phase"]
             if phase == "done" and self._restore_done(job, st):
                 finished += 1
@@ -441,6 +463,7 @@ class ProofService:
                  # "state" lets the client skip straight to RESULT
                  "dedup": deduped,
                  "state": job.state,
+                 "trace_id": job.trace_id,
                  "queue_depth": self.queue.depth()}))
         elif tag == protocol.STATUS:
             job = self._lookup(conn, payload)
@@ -458,6 +481,7 @@ class ProofService:
             header = {"job_id": job.id,
                       "public_input": [hex(x) for x in job.public_input],
                       "spec": job.spec.to_wire(),
+                      "trace_id": job.trace_id,
                       "retries": job.retries}
             conn.send(protocol.OK,
                       protocol.encode_result(header, job.proof_bytes))
@@ -521,3 +545,109 @@ class ProofService:
             conn.send(protocol.ERR, protocol.encode_json(
                 {"reason": f"unknown job {job_id!r}"}))
         return job
+
+    # -- observability plane (serve.py --obs-port) -----------------------------
+
+    def load_trace_merged(self, job_id):
+        """The merged timeline for one job: the store artifact
+        (trace:<job_id>) when present, else the finished Job's in-memory
+        copy. None when the job is unknown or its trace is gone."""
+        if self.store is not None:
+            from ..store import keycache as KC
+            merged = KC.load_trace(self.store, job_id)
+            if merged is not None:
+                return merged
+        job = self.get_job(job_id)
+        return job.trace_dump if job is not None else None
+
+
+class ObsServer:
+    """Pull-based observability endpoint over stdlib HTTP (one thread per
+    request, read-only — it never mutates the service it watches):
+
+        /metrics         Prometheus text exposition (Metrics.to_prometheus:
+                         counters, gauges incl. per-stage MFU, per-round
+                         latency summaries)
+        /healthz         JSON liveness: {"ok": true, uptime, queue depth,
+                         busy workers} — the LB / readiness probe target
+        /trace/<job_id>  the job's merged timeline as Chrome trace-event
+                         JSON (load in chrome://tracing / Perfetto);
+                         ?raw=1 returns the lossless merged dump instead
+
+    Deliberately a separate listener from the proof-service wire plane:
+    scrapers and dashboards must not compete with SUBMIT/RESULT frames,
+    and plain HTTP means curl/Prometheus need no custom codec."""
+
+    def __init__(self, service, host="127.0.0.1", port=0):
+        import http.server
+        svc = service
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: metrics are the log
+                pass
+
+            def do_GET(self):
+                try:
+                    code, ctype, body = _obs_route(svc, self.path)
+                except Exception as e:  # pragma: no cover - defensive
+                    code, ctype = 500, "application/json"
+                    body = protocol.encode_json({"error": repr(e)})
+                svc.metrics.inc("obs_http_requests")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="obs-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def _obs_route(svc, path):
+    """(status, content_type, body bytes) for one observability GET."""
+    from ..trace import to_chrome_trace
+    path, _, query = path.partition("?")
+    if path == "/metrics":
+        text = svc.metrics.to_prometheus(extra_gauges={
+            "queue_depth": svc.queue.depth(),
+            "queue_high_water": svc.queue.high_water,
+        })
+        return 200, "text/plain; version=0.0.4; charset=utf-8", \
+            text.encode()
+    if path == "/healthz":
+        body = protocol.encode_json({
+            "ok": True,
+            "uptime_s": round(time.monotonic() - svc.metrics.started_at, 3),
+            "queue_depth": svc.queue.depth(),
+            "busy_workers": len(svc.pool.busy()),
+            "draining": svc.queue.closed(),
+        })
+        return 200, "application/json", body
+    if path.startswith("/trace/"):
+        job_id = path[len("/trace/"):]
+        merged = svc.load_trace_merged(job_id)
+        if merged is None:
+            return 404, "application/json", protocol.encode_json(
+                {"error": f"no trace for job {job_id!r}"})
+        if "raw=1" in query:
+            return 200, "application/json", protocol.encode_json(merged)
+        return 200, "application/json", \
+            protocol.encode_json(to_chrome_trace(merged))
+    return 404, "application/json", protocol.encode_json(
+        {"error": f"unknown path {path!r}",
+         "endpoints": ["/metrics", "/healthz", "/trace/<job_id>"]})

@@ -20,7 +20,8 @@ cold-vs-warm service round trip, tests/test_store.py.
 from .artifacts import ArtifactStore
 from .keycache import (bucket_store_key, serialize_bucket,
                        deserialize_bucket, store_bucket, load_bucket,
-                       proof_store_key, store_proof, load_proof)
+                       proof_store_key, store_proof, load_proof,
+                       trace_store_key, store_trace, load_trace)
 from .warmstart import (set_jax_cache_env, configure_jax_cache,
                         aot_warmup, warm_spec)
 from .remote import FetchError, fetch_blob, fetch_into
@@ -29,6 +30,7 @@ __all__ = [
     "ArtifactStore", "bucket_store_key", "serialize_bucket",
     "deserialize_bucket", "store_bucket", "load_bucket",
     "proof_store_key", "store_proof", "load_proof",
+    "trace_store_key", "store_trace", "load_trace",
     "set_jax_cache_env", "configure_jax_cache", "aot_warmup", "warm_spec",
     "FetchError", "fetch_blob", "fetch_into",
 ]

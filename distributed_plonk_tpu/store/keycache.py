@@ -86,6 +86,43 @@ def load_proof(store, job_id):
     return blob, pub, meta
 
 
+# -- merged-trace artifacts ---------------------------------------------------
+# One per-job distributed timeline (trace.merge_traces output) joins the
+# content-addressed surface next to the proof it explains: the service
+# stores it at job completion, /trace/<job_id> (serve.py --obs-port) and
+# STORE_FETCH serve it, and bench/loadgen pin its digest. The blob is the
+# merged dump as canonical compact JSON — to_chrome_trace() re-derives
+# the viewer format on demand, so the stored artifact stays the richer,
+# lossless representation.
+
+def trace_store_key(job_id):
+    """Service job id -> merged-trace manifest key."""
+    return f"trace:{job_id}"
+
+
+def store_trace(store, job_id, merged):
+    """Persist one merged timeline; returns its content digest."""
+    blob = json.dumps(merged, separators=(",", ":"),
+                      sort_keys=True).encode()
+    meta = {"kind": "trace", "trace_id": merged.get("trace_id"),
+            "spans": len(merged.get("events") or []),
+            "processes": len(merged.get("processes") or [])}
+    return store.put(trace_store_key(job_id), blob, meta=meta)
+
+
+def load_trace(store, job_id):
+    """-> merged timeline dict, or None (evicted / integrity failure /
+    undecodable — observability never crashes the serving path)."""
+    hit = store.get_entry(trace_store_key(job_id))
+    if hit is None:
+        return None
+    blob, _digest, _meta = hit
+    try:
+        return json.loads(blob.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
 def _fr_bytes(x):
     assert 0 <= x < R_MOD
     return int(x).to_bytes(_FR, "little")

@@ -14,15 +14,18 @@
 #                          the FULL kernel registry + carry contracts + repo
 #                          lints (python -m distributed_plonk_tpu.analysis,
 #                          ~90 s of pure tracing, nothing executes)
-#   scripts/ci.sh chaos    fault-domain suite: dead-worker sweep over every
-#                          protocol phase (byte-identical proofs), breaker
-#                          open/re-admission, cross-host store-fetch resume,
-#                          injection layer (~1-2 min, jax-free: python
-#                          backend worker subprocesses over real TCP), PLUS
-#                          the durable-service-plane suite: service killed
-#                          at every journal transition -> restart recovers
-#                          byte-identically, dedup across restart, torn
-#                          journal, TTL shed, SIGTERM drain
+#   scripts/ci.sh chaos    fault-domain + observability suite: dead-worker
+#                          sweep over every protocol phase (byte-identical
+#                          proofs), breaker open/re-admission, cross-host
+#                          store-fetch resume, injection layer (~1-2 min,
+#                          jax-free: python backend worker subprocesses over
+#                          real TCP), the durable-service-plane suite
+#                          (service killed at every journal transition ->
+#                          restart recovers byte-identically, dedup across
+#                          restart, torn journal, TTL shed, SIGTERM drain),
+#                          PLUS the distributed-tracing suite: serve.py
+#                          subprocess obs endpoints, 3-process fleet prove
+#                          -> one merged trace artifact, wire back-compat
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
@@ -30,6 +33,7 @@ fi
 if [ "$1" = "chaos" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_runtime_faults.py tests/test_service_journal.py \
+    tests/test_trace.py tests/test_obs.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "fast" ]; then

@@ -275,19 +275,32 @@ def main():
             time.sleep(0.01)
 
     def submitter(i):
+        from distributed_plonk_tpu.trace import Tracer
         spec = dict(_MIX[i % len(_MIX)])
         spec.update(seed=1000 + i, priority=i % 3)
         out = {"index": i, "spec": spec}
+        # each job is one end-to-end trace: the client's span is the
+        # root, the server adopts the id (SUBMIT trace_ctx), and STATUS
+        # reports how many spans the merged timeline collected — the
+        # soak checks propagation worked on every single job
+        tracer = Tracer(proc=f"loadgen/{i}")
         try:
             with ServiceClient(host, port) as c:
-                out["job_id"] = c.submit(spec)["job_id"]
-                if kill_marks[i]:
-                    chaos_kill(c, out["job_id"], out)
-                st = c.wait(out["job_id"], timeout_s=args.timeout)
+                with tracer.span("loadgen/submit_wait_verify") as root:
+                    r = c.submit(spec,
+                                 trace_ctx={"trace_id": tracer.trace_id,
+                                            "parent_id": root})
+                    out["job_id"] = r["job_id"]
+                    out["trace_adopted"] = \
+                        r.get("trace_id") == tracer.trace_id
+                    if kill_marks[i]:
+                        chaos_kill(c, out["job_id"], out)
+                    st = c.wait(out["job_id"], timeout_s=args.timeout)
                 out["state"] = st["state"]
                 out["retries"] = st["retries"]
                 out["wait_s"] = st["wait_s"]
                 out["run_s"] = st["run_s"]
+                out["trace_spans"] = st.get("trace_spans")
                 if st["state"] == "done":
                     header, blob = c.result(out["job_id"])
                     out["verified"] = _verify_result(header, blob,
@@ -384,6 +397,14 @@ def main():
             "kills_marked": sum(kill_marks),
             "kills_landed": sum(1 for r in results if r.get("chaos_killed")),
             "recoveries": recoveries,
+        },
+        # tracing: every job's timeline must have collected spans under
+        # the client-supplied trace id (propagation is part of the soak)
+        "trace": {
+            "adopted": sum(1 for r in results if r.get("trace_adopted")),
+            "spans_total": sum(r.get("trace_spans") or 0 for r in results),
+            "spans_recorded":
+                ctr.get("trace_spans_recorded", 0),
         },
         # key_builds == bucket_misses: 0 on a warm-store rerun of the same
         # shape mix (the ISSUE-2 acceptance check; see --store-dir)

@@ -101,6 +101,12 @@ def main():
                          "blob from a warm peer (digest-verified) before "
                          "paying for a full build — a scaled-out replica "
                          "serves warm after one network copy")
+    ap.add_argument("--obs-port", type=int, default=None,
+                    help="observability HTTP port (0 = ephemeral): serves "
+                         "/metrics (Prometheus text exposition incl. "
+                         "per-round latency + MFU gauges), /healthz, and "
+                         "/trace/<job_id> (the job's merged distributed "
+                         "timeline as chrome://tracing JSON)")
     ap.add_argument("--chaos", action="store_true")
     ap.add_argument("--verify", action="store_true")
     ap.add_argument("--allow-remote-shutdown", action="store_true",
@@ -120,6 +126,7 @@ def main():
         set_jax_cache_env(args.store_dir)
     from distributed_plonk_tpu.runtime.faults import FaultInjector
     from distributed_plonk_tpu.service import ProofService
+    from distributed_plonk_tpu.service.server import ObsServer
 
     faults = None
     if args.chaos:
@@ -142,6 +149,10 @@ def main():
         store_peers=parse_peers(args.store_peers)
         if args.store_peers else None).start()
 
+    obs = None
+    if args.obs_port is not None:
+        obs = ObsServer(svc, host=args.host, port=args.obs_port).start()
+
     drain_state = {}
 
     def _drain_handler(signum, _frame):
@@ -156,10 +167,13 @@ def main():
     signal.signal(signal.SIGINT, _drain_handler)
 
     print(json.dumps({"listening": f"{svc.host}:{svc.port}",
+                      "obs": f"{obs.host}:{obs.port}" if obs else None,
                       "workers": args.workers, "chaos": args.chaos,
                       "store": args.store_dir, "journal": journal_dir}),
           flush=True)
     svc.serve_forever()
+    if obs is not None:
+        obs.close()
     if drain_state:
         ctr = svc.metrics.snapshot()["counters"]
         print(json.dumps({"drained": drain_state.get("signal"),
