@@ -666,10 +666,62 @@ def service_roundtrip_main():
         finally:
             shutil.rmtree(journal_dir, ignore_errors=True)
 
+    def batch_prove_ab(n_jobs=4, gates=60):
+        """In-process cross-job batching A/B (the placement layer's
+        data-parallel path): N small same-shape jobs proved BATCHED
+        (prover.prove_many — one commit/eval launch set across jobs) vs
+        the same N proved sequentially, same process, same backend.
+        Returns the speedup + throughput + the byte-identity verdict
+        (batched bytes must equal sequential bytes, the placement
+        contract). Host-oracle basis here; on TPU the batched launches
+        amortize per-dispatch latency, which is where the speedup
+        target lives (ROADMAP chip sweep)."""
+        import random as _r
+        from distributed_plonk_tpu.backend.python_backend import \
+            PythonBackend
+        from distributed_plonk_tpu.prover import prove, prove_many
+        from distributed_plonk_tpu.proof_io import serialize_proof
+        from distributed_plonk_tpu.service.jobs import build_circuit
+
+        specs = [JobSpec.from_wire({"kind": "toy", "gates": gates,
+                                    "seed": 7000 + i})
+                 for i in range(n_jobs)]
+        pk = build_bucket_keys(specs[0])[1]
+        be = PythonBackend()
+        ckts = [build_circuit(s) for s in specs]
+        t0 = time.perf_counter()
+        seq = [serialize_proof(prove(_r.Random(s.seed), c, pk, be))
+               for s, c in zip(specs, ckts)]
+        seq_s = time.perf_counter() - t0
+        ckts2 = [build_circuit(s) for s in specs]
+        t0 = time.perf_counter()
+        proofs, errors = prove_many([_r.Random(s.seed) for s in specs],
+                                    ckts2, pk, PythonBackend())
+        bat_s = time.perf_counter() - t0
+        identical = (errors == [None] * n_jobs
+                     and [serialize_proof(p) for p in proofs] == seq)
+        return {
+            "proofs_per_s": round(n_jobs / bat_s, 3) if bat_s else None,
+            "batch_prove_speedup_vs_sequential":
+                round(seq_s / bat_s, 3) if bat_s else None,
+            "batch_ab_jobs": n_jobs,
+            "batch_ab_sequential_s": round(seq_s, 3),
+            "batch_ab_batched_s": round(bat_s, 3),
+            "batch_prove_byte_identical": bool(identical),
+            "batch_ab_basis": ("host-oracle backend, same process; the "
+                               "dispatch-amortization win is a chip "
+                               "number (ROADMAP sweep)"),
+        }
+
     try:
         cold_s, st, header, blob, m_cold, trace_info = one_run(seed=42)
         warm_s, st_w, _hw, _bw, m_warm, _tw = one_run(seed=43)
         recovery_ok, recovery_resumes = restart_recovery_run()
+        try:
+            batch_ab = batch_prove_ab()
+        except Exception as e:  # diagnostic; never fail the canary
+            batch_ab = {"batch_ab_error": repr(e),
+                        "batch_prove_byte_identical": False}
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
@@ -697,6 +749,13 @@ def service_roundtrip_main():
             "trace_spans_total": trace_info["spans"],
             "trace_ctx_adopted": bool(trace_info["adopted"]),
             "trace_artifact_digest": trace_info["digest"],
+            # placement + cross-job batching (the PR 11 canary): how the
+            # scheduler routed this run's jobs, and the in-process
+            # batched-vs-sequential A/B (byte-identity is part of it)
+            "placement_decisions": {
+                k: v for k, v in sorted(m_cold["counters"].items())
+                if k.startswith(("placement_", "batch_", "submesh_"))},
+            **batch_ab,
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
             "service_jobs_completed":

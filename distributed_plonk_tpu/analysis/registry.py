@@ -196,6 +196,20 @@ def _ntt_entries():
     out.append(pallas_ntt(64, False, True, False, 64))   # one group, R=6
     out.append(pallas_ntt(64, True, True, False, 8))     # two groups, R=3
     out.append(pallas_ntt(32, False, False, True, 32))   # odd log2, batch
+
+    # deferred output permutation (DPT_R3_BITREV consumer-side fusion):
+    # the forward batch kernel that SKIPS the bit-reversal gather — the
+    # round-3 producer launches run this program, with the consuming
+    # iNTT's input_perm paying the one remaining gather. Same limb
+    # bounds as the permuted variant (a gather moves lanes, not values);
+    # proved for both stage cores.
+    for kern, tag in (("xla", "radix4"), ("pallas", "pallas")):
+        plan = NTT.NttPlan(64)
+        fn, consts = plan.traced_kernel(False, True, radix=4, batch=True,
+                                        kernel=kern, defer_perm=True)
+        cnp = {k: np.asarray(v) for k, v in consts.items()}
+        out.append(Entry(f"ntt/n64_{tag}_batch3_coset_defer_perm", fn,
+                         (limb_rows(16, 3, 64), cnp), [(0, U16)]))
     return out
 
 

@@ -40,6 +40,20 @@ from .limbs import ints_to_limbs
 # (the value-identical reference path, kept like DPT_NTT_KERNEL=xla).
 _R3_FUSE = os.environ.get("DPT_R3_FUSE", "1") != "0"
 
+# Bit-reversal deferral for the FUSED round 3 (DPT_R3_BITREV, default on;
+# only meaningful under DPT_R3_FUSE): every forward coset-FFT launch in
+# the quotient pipeline emits in constant-geometry (bit-reversed) order
+# (NttPlan defer_perm) and the accumulator planes stay bit-reversed all
+# the way to the combine — valid because every fold is pointwise, so it
+# holds in any order the operands share (the z_next roll and the domain
+# tables are re-indexed once, per-plan). The ONE place the order returns
+# to natural is the consuming coset-iNTT's input gather (kernel_fused
+# input_perm), fused into that program's first stage reads — the
+# "consumer-side fusion" follow-on noted in backend/ntt_pallas.py: ~26
+# standalone O(m) bit-reversal gathers per round 3 collapse into 1.
+# 0 restores per-launch output permutation (bit-identical either way).
+_R3_BITREV = os.environ.get("DPT_R3_BITREV", "1") != "0"
+
 
 class JaxBackend:
     """Backend over single-device jitted kernels.
@@ -267,29 +281,33 @@ class JaxBackend:
         elems_cap = 1 << (23 if FJ._use_pallas((16, 1 << 22)) else 21)
         return max(1, min(self._NTT_BATCH, elems_cap // domain_size))
 
-    def _kernel_batches(self, domain, hs, inverse, coset):
+    def _kernel_batches(self, domain, hs, inverse, coset, defer_perm=False):
         """Yield (16, B, m) NTT result batches covering hs in order, B
         capped by the launch budget (_ntt_chunk). _kernel_many collects,
         quotient_streamed folds each batch into accumulators so no batch
-        outlives its consumption."""
+        outlives its consumption. defer_perm: bit-reversed-order output
+        (the round-3 deferral, DPT_R3_BITREV)."""
         plan = ntt_jax.get_plan(domain.size)
         chunk = self._ntt_chunk(domain.size)
-        if chunk == 1:
+        if chunk == 1 and not defer_perm:
             fn1 = plan.kernel(inverse=inverse, coset=coset, boundary="mont")
             for h in hs:
                 yield fn1(self._pad_to(h, domain.size))[:, None]
             return
-        fn = plan.kernel_batch(inverse=inverse, coset=coset)
-        for i in range(0, len(hs), chunk):
+        fn = plan.kernel_batch(inverse=inverse, coset=coset,
+                               defer_perm=defer_perm)
+        for i in range(0, len(hs), max(chunk, 1)):
             yield fn(jnp.stack([self._pad_to(h, domain.size)
-                                for h in hs[i:i + chunk]], axis=1))
+                                for h in hs[i:i + max(chunk, 1)]], axis=1))
 
-    def _kernel_many(self, domain, hs, inverse, coset, post=None):
+    def _kernel_many(self, domain, hs, inverse, coset, post=None,
+                     defer_perm=False):
         """B NTTs in capped batches; `post` (if given) maps each launch's
         (16, B, m) result before results are split out — e.g. the round-3
         limb packing, applied while at most one batch is unpacked."""
         out = []
-        for res in self._kernel_batches(domain, hs, inverse, coset):
+        for res in self._kernel_batches(domain, hs, inverse, coset,
+                                        defer_perm=defer_perm):
             if post is not None:
                 res = post(res)
             out.extend(res[:, j] for j in range(res.shape[1]))
@@ -326,19 +344,44 @@ class JaxBackend:
     _STREAM_SYNC_MIN_M = int(os.environ.get("DPT_STREAM_SYNC_MIN_M",
                                             str(1 << 23)))
 
-    def coset_fft_many_packed(self, domain, hs):
+    def coset_fft_many_packed(self, domain, hs, defer_perm=False):
         """coset_fft_many, but each (16, m) result returns limb-packed
         (8, m). Packing rides the launch loop so at most one batch of
-        unpacked outputs is ever resident."""
-        return self._kernel_many(domain, hs, False, True, post=PJ.pack_jit)
+        unpacked outputs is ever resident. defer_perm: results stay in
+        bit-reversed order (DPT_R3_BITREV pipeline)."""
+        return self._kernel_many(domain, hs, False, True, post=PJ.pack_jit,
+                                 defer_perm=defer_perm)
 
-    def _domain_tables_packed(self, m, n, group_gen):
-        key = (m, n)
+    def _domain_tables_packed(self, m, n, group_gen, bitrev=False):
+        """Packed quotient-domain tables; bitrev=True re-indexes every
+        lane through the bit-reversal permutation so the tables line up
+        with the deferred-order accumulator planes (one extra gather at
+        cache build, amortized over every prove of the shape)."""
+        key = (m, n, bitrev)
         with self._cache_lock:
             hit = self._domain_tabs_packed.get(key)
         if hit is None:
             tabs = PJ.domain_tables_jit(m, n, FR_GENERATOR, group_gen)
+            if bitrev:
+                perm = jnp.asarray(ntt_jax.get_plan(m).perm)
+                tabs = {kk: v[:, perm] for kk, v in tabs.items()}
             hit = {kk: PJ.pack_jit(v) for kk, v in tabs.items()}
+            with self._cache_lock:
+                self._domain_tabs_packed[key] = hit
+        return hit
+
+    def _roll_perm(self, m, ratio):
+        """Gather index array carrying the z -> z_next roll INTO the
+        bit-reversed plane order: with perm the bit-reversal permutation,
+        bitrev(roll(natural, ratio))[i] = bitrev(z)[perm[(perm[i] +
+        ratio) % m]] — one precomputed gather replaces the natural-order
+        roll (both are pure data movement)."""
+        key = ("roll_perm", m, ratio)
+        with self._cache_lock:
+            hit = self._domain_tabs_packed.get(key)
+        if hit is None:
+            perm = ntt_jax.get_plan(m).perm.astype(np.int64)
+            hit = jnp.asarray(perm[(perm + ratio) % m].astype(np.int32))
             with self._cache_lock:
                 self._domain_tabs_packed[key] = hit
         return hit
@@ -389,20 +432,32 @@ class JaxBackend:
         return pro
 
     def _r3_accumulate(self, n, m, quot_domain, beta, gamma, sel_h, sigma_h,
-                       wire_polys, perm_poly, pi_coeffs):
+                       wire_polys, perm_poly, pi_coeffs, bitrev=False):
         """Shared front half of round 3: base coset FFTs + gate/sigma
         plane folding. Returns (wires_p, z_p, gate_p, acc2_p, throttle).
         Under DPT_R3_FUSE each selector/sigma batch's fold runs as the
         EPILOGUE of its own coset-FFT program (NttPlan.kernel_fused) —
         value-identical to the standalone jitted steps, minus their
-        write-plane + read-plane HBM pass per batch."""
+        write-plane + read-plane HBM pass per batch.
+
+        bitrev=True (DPT_R3_BITREV, fused path only): every FFT launch
+        defers its output bit-reversal, so all returned planes are in
+        constant-geometry order — the folds are pointwise, so they are
+        value-identical in any shared order; the z_next roll becomes one
+        re-indexed gather (_roll_perm). The caller owns getting back to
+        natural order (the consuming iNTT's input_perm)."""
         ratio = m // n
+        bitrev = bitrev and _R3_FUSE  # only the fused folds speak deferred
         base = self.coset_fft_many_packed(
-            quot_domain, list(wire_polys) + [perm_poly, pi_coeffs])
+            quot_domain, list(wire_polys) + [perm_poly, pi_coeffs],
+            defer_perm=bitrev)
         wires_p = base[:5]
         z_p = base[5]
         gate_p = base[6]               # gate accumulator starts as pi plane
-        acc2_p = PJ.roll_jit(z_p, ratio)  # acc2 starts as z_next
+        # acc2 starts as z_next: a natural-order roll, or — deferred —
+        # the same data movement through the re-indexed gather
+        acc2_p = (z_p[:, self._roll_perm(m, ratio)] if bitrev
+                  else PJ.roll_jit(z_p, ratio))
         del base
 
         sync_every = (self._STREAM_SYNC_EVERY
@@ -432,7 +487,8 @@ class JaxBackend:
                       for h in sel_h[i:i + chunk]]
                 fnk = plan.kernel_fused(
                     False, True, key=("r3gate", i, len(hs)),
-                    epilogue=self._gate_epilogue(i, len(hs)))
+                    epilogue=self._gate_epilogue(i, len(hs)),
+                    defer_perm=bitrev)
                 gate_p = fnk((jnp.stack(hs, axis=1),),
                              (gate_p,) + tuple(w))
                 _throttle(gate_p)
@@ -441,7 +497,8 @@ class JaxBackend:
                       for h in sigma_h[i:i + chunk]]
                 fnk = plan.kernel_fused(
                     False, True, key=("r3sigma", i, len(hs)),
-                    epilogue=self._sigma_epilogue(i, len(hs)))
+                    epilogue=self._sigma_epilogue(i, len(hs)),
+                    defer_perm=bitrev)
                 acc2_p = fnk((jnp.stack(hs, axis=1),),
                              (acc2_p, beta_c, gamma_c) + tuple(w))
                 _throttle(acc2_p)
@@ -516,16 +573,23 @@ class JaxBackend:
                 n, m, quot_domain, k, beta, gamma, alpha, alpha_sq_div_n,
                 sel_h, sigma_h, wire_polys, perm_poly, pi_coeffs)
             return self.coset_ifft_h(quot_domain, evals)
-        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen)
+        # DPT_R3_BITREV: the whole accumulation runs in bit-reversed
+        # order (no per-launch output gathers) and the combine's result
+        # returns to natural order through the consuming iNTT's input
+        # gather — the one bit-reversal pass left in round 3
+        bitrev = _R3_BITREV
+        tabs = self._domain_tables_packed(m, n, quot_domain.group_gen,
+                                          bitrev=bitrev)
         wires_p, z_p, gate_p, acc2_p, _throttle = self._r3_accumulate(
             n, m, quot_domain, beta, gamma, sel_h, sigma_h, wire_polys,
-            perm_poly, pi_coeffs)
+            perm_poly, pi_coeffs, bitrev=bitrev)
         k_arr = jnp.asarray(PJ.lift(list(k))).reshape(FR_LIMBS, len(k), 1)
         scal = [jnp.asarray(PJ.lift_scalar(x))
                 for x in (beta, gamma, alpha, alpha_sq_div_n)]
         plan = ntt_jax.get_plan(quot_domain.size)
         fnk = plan.kernel_fused(True, True, key=("r3combine",),
-                                prologue=self._combine_prologue(m))
+                                prologue=self._combine_prologue(m),
+                                input_perm=bitrev)
         poly = fnk(tuple(wires_p) + (z_p, gate_p, acc2_p, tabs["ep"],
                                      tabs["zh_inv"], tabs["shifted_inv"],
                                      k_arr) + tuple(scal))[:, 0]
@@ -547,6 +611,25 @@ class JaxBackend:
 
     def commit_many_h(self, ck, hs):
         return self._ctx(ck).msm_mont_limbs_many(hs)
+
+    # cross-job commit batching (the placement layer's data-parallel
+    # path): one launch covers up to DPT_MSM_JOB_BATCH handles — wider
+    # than the per-prove DPT_MSM_BATCH because a batch of N small jobs
+    # commits 5N same-shape wire polys per round, and the per-launch
+    # fixed cost is what batching across jobs exists to amortize. Plane
+    # memory scales with the chunk (B*W*buckets), so the default stays
+    # modest; small domains are exactly where it is cheap.
+    _MSM_JOB_BATCH = int(os.environ.get("DPT_MSM_JOB_BATCH", "16"))
+
+    def commit_batch(self, ck, hs):
+        """Multi-proof commit path (prover.prove_many): B commitments —
+        typically the SAME round of N different jobs — in launches of up
+        to _MSM_JOB_BATCH, with same-width handles sharing ONE stacked
+        digit-extraction launch (MsmContext._digits_many_fn). Results are
+        bit-identical to commit_many_h per handle (each MSM is
+        independent; grouping only changes launch boundaries)."""
+        return self._ctx(ck).msm_mont_limbs_many(
+            hs, chunk=max(1, self._MSM_JOB_BATCH))
 
     def degree_is(self, h, d):
         if h.shape[1] <= d:

@@ -700,6 +700,11 @@ class MsmContext:
             self._digits_batch_fn = jax.jit(
                 partial(digits_from_mont, c=self.c_batch,
                         padded_n=self.padded_n))
+        # stacked digit extraction (the cross-job commit_batch path): one
+        # vmapped launch turns B same-width coefficient handles into the
+        # (B, W, padded_n) digit tensor, instead of B separate dispatches.
+        # vmap of the same elementwise program — bit-identical digits.
+        self._digits_many_fn = jax.jit(jax.vmap(self._digits_batch_fn))
         self._chunk_fns = {}
         self._chunk_calls = {}  # (nc, g) -> times executed (warm detection)
         self._finish_fns = {}
@@ -902,9 +907,16 @@ class MsmContext:
     # shapes small across prover rounds (8, then the 5/2-size residuals)
     _BATCH_CHUNK = int(os.environ.get("DPT_MSM_BATCH", "8"))
 
-    def _run_batches(self, items, make_digits):
+    def _run_batches(self, items, make_digits, chunk=None, stacked=False):
         """items -> affine points; digits are materialized per batch chunk
-        so peak digit memory is _BATCH_CHUNK tensors, not len(items).
+        so peak digit memory is `chunk` (default _BATCH_CHUNK) tensors,
+        not len(items).
+
+        stacked=True (items are same-width device handles): each chunk's
+        digit extraction runs as ONE vmapped launch over the stacked
+        handles (`_digits_many_fn`) instead of one dispatch per handle —
+        the cross-job commit_batch path, where a placement batch of N
+        jobs commits 5N wire polys per round.
 
         Double-buffered: batch k's (24, B) device totals convert to host
         only AFTER batch k+1's work is enqueued, so the device never sits
@@ -912,6 +924,7 @@ class MsmContext:
         ONE extra batch's queued work is ever outstanding)."""
         out = []
         pending = None  # (batch_width, device totals) awaiting decode
+        batch_chunk = chunk or self._BATCH_CHUNK
 
         def drain(p):
             B, (tx, ty, tz) = p
@@ -919,7 +932,7 @@ class MsmContext:
             out.extend(_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
                        for j in range(B))
 
-        for i in range(0, len(items), self._BATCH_CHUNK):
+        for i in range(0, len(items), batch_chunk):
             # until the one-shot adds/s calibration has latched, drain the
             # previous batch BEFORE launching (old behavior): otherwise the
             # calibration fence inside _exec_chunked would time the timed
@@ -929,8 +942,11 @@ class MsmContext:
                     not in MsmContext._measured_adds_per_s):
                 drain(pending)
                 pending = None
-            digits = jnp.stack(
-                [make_digits(it) for it in items[i:i + self._BATCH_CHUNK]])
+            part_items = items[i:i + batch_chunk]
+            if stacked and len({it.shape for it in part_items}) == 1:
+                digits = self._digits_many_fn(jnp.stack(part_items))
+            else:
+                digits = jnp.stack([make_digits(it) for it in part_items])
             totals = self._exec_chunked(digits)
             if pending is not None:
                 drain(pending)
@@ -939,12 +955,17 @@ class MsmContext:
             drain(pending)
         return out
 
-    def msm_mont_limbs_many(self, hs):
+    def msm_mont_limbs_many(self, hs, chunk=None):
         """Commit B Montgomery coefficient handles in batched launches;
-        returns B affine points (host ints)."""
+        returns B affine points (host ints). `chunk` widens/narrows the
+        per-launch batch (the cross-job commit path passes the job-batch
+        width so one placement batch's same-round commits share launches);
+        same-width handles in a chunk get ONE stacked digit-extraction
+        launch."""
         for h in hs:
             assert h.shape[1] <= self.n, (h.shape, self.n)
-        return self._run_batches(hs, self._digits_batch_fn)
+        return self._run_batches(hs, self._digits_batch_fn, chunk=chunk,
+                                 stacked=True)
 
     def msm_many(self, scalar_lists):
         """B MSMs over host int scalar lists in batched launches."""

@@ -280,6 +280,20 @@ def _radix4_core(v, consts, coset_pre=False):
     return v
 
 
+def _radix2_core(v, exps, pow_tab):
+    """All radix-2 butterfly stages on (16, B, n) rows in natural order;
+    output in bit-reversed order (no perm, no 1/n)."""
+    n = v.shape[2]
+    if n == 1:
+        return v
+
+    def stage(carry, e):
+        return _stage2(carry, e, pow_tab), None
+
+    v, _ = lax.scan(stage, v, exps)
+    return v
+
+
 def batched_butterflies(v, perm, exps, pow_tab):
     """Constant-geometry radix-2 NTT core on a batch of rows.
 
@@ -289,15 +303,7 @@ def batched_butterflies(v, perm, exps, pow_tab):
     Returns the (i)NTT in natural order (1/n scaling NOT included).
     Kept as the radix-2 parity/debug core; prefer `run_stages` +
     `NttPlan.core_consts`, which pick the active radix."""
-    n = v.shape[2]
-    if n == 1:
-        return v
-
-    def stage(carry, e):
-        return _stage2(carry, e, pow_tab), None
-
-    v, _ = lax.scan(stage, v, exps)
-    return v[:, :, perm]
+    return _radix2_core(v, exps, pow_tab)[:, :, perm]
 
 
 def run_stages(v, consts):
@@ -440,7 +446,8 @@ class NttPlan:
                 consts["ppost"] = jnp.asarray(self._pallas_post_tab(coset))
         return consts
 
-    def _apply_batched(self, v, consts, radix, kernel="xla"):
+    def _apply_batched(self, v, consts, radix, kernel="xla",
+                       defer_perm=False):
         """(16, B, n) Montgomery rows -> full (i)(coset)NTT: butterflies +
         output permutation + fused scales, radix/kernel-selected. The
         pallas path runs the fused multi-stage groups (coset pre-scale in
@@ -449,21 +456,29 @@ class NttPlan:
         stages so the coset tables ride the first butterfly and the perm
         gather + inverse scales fuse with the last one; the radix-2 path
         keeps the historical standalone pre/post table multiplies
-        (parity/debug reference)."""
+        (parity/debug reference).
+
+        defer_perm=True (forward launches only) SKIPS the output
+        bit-reversal gather: the result stays in constant-geometry
+        (bit-reversed) order and the CONSUMER absorbs the permutation —
+        the round-3 pipeline keeps every accumulator plane bit-reversed
+        and pays one gather at the consuming iNTT's input instead of one
+        standalone O(n) pass per FFT launch (DPT_R3_BITREV)."""
         n = self.n
         if kernel == "pallas" and _active_kernel("pallas") == "pallas":
             from . import ntt_pallas
             v = ntt_pallas.run_groups(v, consts)
-            return v[:, :, consts["perm"]]
+            return v if defer_perm else v[:, :, consts["perm"]]
         if radix == 4:
             v = _radix4_core(v, consts, coset_pre="pre" in consts)
-            v = v[:, :, consts["perm"]]
         else:
             if "pre" in consts:
                 v = FJ.mont_mul(FR, v, consts["pre"][:, None, :])
-            v = batched_butterflies(v, consts["perm"], consts["exps"],
-                                    consts["pow"])
+            v = _radix2_core(v, consts["exps"], consts["pow"])
+        if not defer_perm:
+            v = v[:, :, consts["perm"]]
         if "post" in consts:
+            assert not defer_perm, "defer_perm is forward-only (no post)"
             post = consts["post"]
             if post.shape[1] == 1:  # plain 1/n: broadcast symbolically
                 post = jnp.broadcast_to(post, (FR_LIMBS, n))
@@ -506,28 +521,35 @@ class NttPlan:
         return lambda v: fn(v, consts)
 
     def kernel_batch(self, inverse=False, coset=False, radix=None,
-                     kernel=None):
+                     kernel=None, defer_perm=False):
         """Jitted (16, B, n) -> (16, B, n) Montgomery-boundary kernel: B
         polynomials in ONE launch (the prover's round-1/round-3 NTT batches;
         the reference fans these out as concurrent RPCs,
         dispatcher2.rs:294-321,382-414 — on device they are one program).
-        Compiled once per (mode, radix, kernel, B)."""
+        Compiled once per (mode, radix, kernel, B). defer_perm=True emits
+        the result in bit-reversed order (forward only — the consumer
+        absorbs the permutation; see _apply_batched)."""
         radix = self._effective_radix(radix)
         kmode = self._effective_kernel(kernel)
-        key = (inverse, coset, "batch", radix, kmode)
+        if defer_perm and inverse:
+            raise ValueError("defer_perm is forward-only")
+        key = (inverse, coset, "batch_noperm" if defer_perm else "batch",
+               radix, kmode)
         if key not in self._fns:
             consts = self._kernel_consts(inverse, coset, radix, kmode)
 
             @jax.jit
             def fn(v, consts):
-                return self._apply_batched(v, consts, radix, kmode)
+                return self._apply_batched(v, consts, radix, kmode,
+                                           defer_perm=defer_perm)
 
             self._fns[key] = (fn, consts)
         fn, consts = self._fns[key]
         return lambda v: fn(v, consts)
 
     def kernel_fused(self, inverse=False, coset=False, *, key,
-                     prologue=None, epilogue=None, radix=None, kernel=None):
+                     prologue=None, epilogue=None, radix=None, kernel=None,
+                     input_perm=False, defer_perm=False):
         """Jitted Montgomery-boundary batch kernel with caller-supplied
         pointwise stages fused into the SAME program:
 
@@ -543,10 +565,21 @@ class NttPlan:
         iNTT (fusing into the first inverse stage's reads). `key` must
         uniquely identify the prologue/epilogue semantics — the traced
         closure is memoized under (key, mode) exactly like the plain
-        kernels. Returns fn(pro_args, epi_args=())."""
+        kernels. Returns fn(pro_args, epi_args=()).
+
+        Bit-reversal deferral (DPT_R3_BITREV): defer_perm=True leaves a
+        FORWARD launch's output (and so the epilogue's input) in
+        constant-geometry order — valid because the epilogues are pure
+        pointwise folds, so they hold in any order the operands share.
+        input_perm=True gathers the prologue's output through the
+        bit-reversal permutation before the butterflies — the one place
+        the deferred order returns to natural, fused into the consuming
+        iNTT program's first stage reads instead of a standalone pass
+        per producer launch."""
         radix = self._effective_radix(radix)
         kmode = self._effective_kernel(kernel)
-        ck = ("fused", key, inverse, coset, radix, kmode)
+        ck = ("fused", key, inverse, coset, radix, kmode,
+              input_perm, defer_perm)
         if ck not in self._fns:
             consts = self._kernel_consts(inverse, coset, radix, kmode)
 
@@ -554,7 +587,10 @@ class NttPlan:
             def fn(pro_args, epi_args, consts):
                 v = prologue(*pro_args) if prologue is not None \
                     else pro_args[0]
-                v = self._apply_batched(v, consts, radix, kmode)
+                if input_perm:
+                    v = v[:, :, consts["perm"]]
+                v = self._apply_batched(v, consts, radix, kmode,
+                                        defer_perm=defer_perm)
                 if epilogue is not None:
                     return epilogue(v, *epi_args)
                 return v
@@ -569,7 +605,8 @@ class NttPlan:
                                                 tuple(epi_args), consts)
 
     def traced_kernel(self, inverse=False, coset=False, boundary="mont",
-                      radix=None, batch=False, kernel=None):
+                      radix=None, batch=False, kernel=None,
+                      defer_perm=False):
         """(jitted fn, consts dict) for one kernel variant — the raw
         pair behind `kernel`/`kernel_batch`'s memo. The static verifier
         (analysis/registry.py) traces `fn(v, consts)` through
@@ -582,8 +619,12 @@ class NttPlan:
             if boundary != "mont":
                 raise ValueError(
                     "batch kernels are Montgomery-boundary only")
-            self.kernel_batch(inverse, coset, radix=radix, kernel=kmode)
-            key = (inverse, coset, "batch", radix, kmode)
+            self.kernel_batch(inverse, coset, radix=radix, kernel=kmode,
+                              defer_perm=defer_perm)
+            key = (inverse, coset,
+                   "batch_noperm" if defer_perm else "batch", radix, kmode)
+        elif defer_perm:
+            raise ValueError("defer_perm needs batch=True")
         else:
             self.kernel(inverse, coset, boundary=boundary, radix=radix,
                         kernel=kmode)

@@ -46,7 +46,11 @@ applied pre-permutation through a bit-reverse-reordered table. The
 output bit-reversal itself stays an XLA gather on the kernel result (a
 rectangular-block write of a bit-reversed tile is not expressible as a
 BlockSpec; the gather is pure data movement and fuses with whatever
-consumes the output — e.g. the round-3 pointwise epilogues).
+consumes the output — e.g. the round-3 pointwise epilogues). Consumer-
+side fusion LANDED (DPT_R3_BITREV, jax_backend): the fused round-3
+pipeline skips this gather entirely on every producer launch
+(NttPlan kernel defer_perm — accumulators stay in constant-geometry
+order) and pays ONE input gather at the consuming coset-iNTT instead.
 
 Select with DPT_NTT_KERNEL=auto|pallas|xla (auto: pallas on TPU;
 interpret mode elsewhere is test-only, like msm_pallas). The radix-4

@@ -227,6 +227,12 @@ class ProverCheckpoint:
         rng.setstate(state["rng_state"])
         _restore_transcript(transcript, state["transcript"])
 
+    def has_snapshot(self):
+        """Cheap existence probe (no decode, no metrics side effects):
+        the batched prover uses it to route members that must RESUME to
+        the sequential path, whose resume contract is the pinned one."""
+        return os.path.exists(self.path)
+
     def clear(self):
         try:
             os.remove(self.path)
@@ -274,6 +280,9 @@ class StoreCheckpoint(ProverCheckpoint):
         if state is None:  # parse damage below the SHA's radar (stale fmt)
             self.clear()
         return state
+
+    def has_snapshot(self):
+        return self.store.get_entry(self.key) is not None
 
     def clear(self):
         self.store.delete(self.key)

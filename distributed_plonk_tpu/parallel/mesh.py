@@ -56,6 +56,19 @@ def init_multihost(coordinator, num_processes, process_id,
     return jax.process_count(), jax.device_count()
 
 
+def make_submesh(devices):
+    """1-D mesh over an EXPLICIT device list — the placement scheduler's
+    construction hook (service/placement.py): it partitions jax.devices()
+    into disjoint leased submeshes, and each lease's big sharded prove
+    runs on a Mesh built from exactly its devices, so concurrent
+    submeshes never contend for a chip. The device list should be
+    ICI-contiguous (the leaser hands out contiguous runs of the
+    enumeration order) for collective locality."""
+    devs = list(devices)
+    assert devs, "submesh needs at least one device"
+    return jax.sharding.Mesh(np.array(devs), (SHARD_AXIS,))
+
+
 def make_mesh(n_devices=None, platform=None):
     """1-D mesh over the first n_devices (default: all) devices.
 

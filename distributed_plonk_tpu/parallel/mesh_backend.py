@@ -62,6 +62,10 @@ class MeshBackend(JaxBackend):
     # GSPMD-sharded lane axis per quotient chunk would reshard every slice
     quotient_streamed = None
     quotient_poly_streamed = None
+    # MeshMsmContext has no stacked-chunk commit path; prove_many's
+    # getattr falls back to commit_many_h (and mesh placements are
+    # single-job groups anyway — big proves shard, they don't batch)
+    commit_batch = None
 
     # minimum per-device coefficient count for sharding a handle: below
     # this, elementwise/scan round math runs REPLICATED on the mesh

@@ -18,6 +18,7 @@ bytes match FakeStandardTranscript exactly.
 """
 
 import random
+import time
 
 from .checkpoint import (_point_dec, _point_enc, dump_handle, load_handle,
                          workload_fingerprint)
@@ -344,6 +345,353 @@ def prove(rng, circuit, pk, backend, tracer=None, checkpoint=None):
 
 def _rand(rng, count):
     return [rng.randrange(R_MOD) for _ in range(count)]
+
+
+class _Member:
+    """One job's slice of a batched prove: its own rng, transcript,
+    tracer, checkpoint, and round outputs — everything Fiat-Shamir or
+    blinding touches stays strictly per member, which is what makes the
+    batch byte-identical to N sequential proves."""
+
+    def __init__(self, i, rng, ckt, tracer, checkpoint):
+        self.i = i
+        self.rng = rng or random.Random()
+        self.ckt = ckt
+        self.tr = tracer or NULL_TRACER
+        self.checkpoint = checkpoint
+        self.transcript = StandardTranscript()
+        self.pub = ckt.public_input()
+        self.fp = None
+        self.ck_arrays = {}
+        self.ck_meta = {}
+
+
+def prove_many(rngs, circuits, pk, backend, tracers=None, checkpoints=None,
+               abort_on=()):
+    """N same-shape TurboPlonk proofs in LOCKSTEP, with the cross-job
+    kernel launches batched: the round-1 wire iFFTs/commit MSMs, the
+    round-2 permutation commits, the round-3 split-quotient commits, the
+    round-4 evaluations, and the round-5 opening commits of ALL members
+    each run as one batched backend call (`commit_batch` when the backend
+    has it, else `commit_many_h`; `ifft_many`; `eval_many_h`) instead of
+    N separate call sequences. This is the data-parallel small-job path
+    of the placement scheduler (service/placement.py) — throughput scales
+    in jobs per launch while each job's proof bytes stay IDENTICAL to a
+    sequential `prove`, because per-job state (transcript sponge,
+    blinding rng, challenges) never crosses members and every batched
+    kernel computes each member's slice independently (MSM results are
+    exact group elements; batch width only moves launch boundaries).
+
+    rngs/circuits/tracers/checkpoints: parallel per-member lists (tracers
+    and checkpoints optional). All circuits must share `pk`'s shape.
+
+    Failure isolation: a member whose round-boundary control point raises
+    (worker kill, timeout — anything the checkpoint guard fires) is
+    dropped from the batch with its exception recorded, and the
+    SURVIVORS finish unaffected; the dead member's snapshot is durable,
+    so its retry resumes alone through the sequential path. Exception
+    types in `abort_on` (e.g. a drain) propagate instead, aborting the
+    whole batch. Members that already HAVE a snapshot are routed to the
+    sequential prover up front — resume semantics stay the single-job
+    contract pinned by tests/test_checkpoint.py.
+
+    Returns (proofs, errors): per-member Proof-or-None and
+    exception-or-None lists."""
+    N = len(circuits)
+    rngs = list(rngs)
+    tracers = list(tracers) if tracers is not None else [None] * N
+    checkpoints = (list(checkpoints) if checkpoints is not None
+                   else [None] * N)
+    n = pk.domain_size
+    domain = pk.domain
+    num_wire_types = NUM_WIRE_TYPES
+    quot_domain = Domain((num_wire_types + 1) * (n + 1) + 1)
+    m = quot_domain.size
+    ck = pk.ck
+    sel_h, sigma_h = backend.pk_polys(pk)
+    commit_many = (getattr(backend, "commit_batch", None)
+                   or backend.commit_many_h)
+
+    proofs = [None] * N
+    errors = [None] * N
+    live = []
+    for i in range(N):
+        mb = _Member(i, rngs[i], circuits[i], tracers[i], checkpoints[i])
+        if mb.checkpoint is not None and \
+                getattr(mb.checkpoint, "has_snapshot", lambda: False)():
+            # mid-prove state exists: resume through the sequential
+            # prover, whose restore path is the pinned contract
+            try:
+                proofs[i] = prove(mb.rng, mb.ckt, pk, backend,
+                                  tracer=mb.tr, checkpoint=mb.checkpoint)
+            except abort_on:
+                raise
+            except Exception as e:
+                errors[i] = e
+            continue
+        mb.transcript.append_vk_and_pub_input(pk.vk, mb.pub)
+        if mb.checkpoint is not None:
+            mb.fp = workload_fingerprint(pk.vk, mb.pub)
+            # round-0 control point, parity with prove(): loading the
+            # (absent) snapshot runs the guard's pre-round check — a
+            # kill/drain armed at round 0 fires for batch members too
+            try:
+                mb.checkpoint.load(mb.fp)
+            except abort_on:
+                raise
+            except Exception as e:
+                errors[i] = e
+                continue
+        live.append(mb)
+
+    def each_live(fn):
+        """fn(member) for every live member; a raising member is failed
+        and dropped (abort_on propagates — the whole batch stops)."""
+        nonlocal live
+        kept = []
+        for mb in live:
+            try:
+                fn(mb)
+            except abort_on:
+                raise
+            except Exception as e:  # member-local failure, batch survives
+                errors[mb.i] = e
+                continue
+            kept.append(mb)
+        live = kept
+
+    def member_save(mb, round_no):
+        if mb.checkpoint is None:
+            return
+        with mb.tr.span("checkpoint_save", round=round_no):
+            mb.checkpoint.save(
+                round_no, mb.fp, mb.rng, mb.transcript,
+                {k: dump_handle(backend, h)
+                 for k, h in mb.ck_arrays.items()},
+                mb.ck_meta)
+
+    def mark_round(name, wall0, dur):
+        # every member's timeline shows the batch round it rode in (the
+        # launches are shared, so the span IS each job's wall time)
+        for mb in live:
+            mb.tr.add_event(name, ts=wall0, dur_s=dur,
+                            batched_jobs=len(live))
+
+    # --- Round 1: wire polynomials (one iFFT + one commit launch set) -------
+    w0, p0 = time.time(), time.perf_counter()
+    if live:
+        all_wires = []
+        for mb in live:
+            all_wires.extend(backend.wire_values(mb.ckt))
+        coeffs = backend.ifft_many(domain, all_wires)
+        polys = []
+        for j, mb in enumerate(live):
+            cs = coeffs[num_wire_types * j:num_wire_types * (j + 1)]
+            mb.wire_polys = [backend.blind(c, _rand(mb.rng, 2), n)
+                             for c in cs]
+            polys.extend(mb.wire_polys)
+        comms = commit_many(ck, polys)
+        for j, mb in enumerate(live):
+            mb.wires_poly_comms = \
+                comms[num_wire_types * j:num_wire_types * (j + 1)]
+
+        def r1(mb):
+            mb.transcript.append_commitments(b"witness_poly_comms",
+                                             mb.wires_poly_comms)
+            if mb.checkpoint is not None:
+                mb.ck_arrays.update({"wire_poly_%d" % i: h
+                                     for i, h in enumerate(mb.wire_polys)})
+                mb.ck_meta["wires_poly_comms"] = [
+                    _point_enc(p) for p in mb.wires_poly_comms]
+            member_save(mb, 1)
+        each_live(r1)
+        mark_round("round1", w0, time.perf_counter() - p0)
+
+    # --- Round 2: permutation product ---------------------------------------
+    w0, p0 = time.time(), time.perf_counter()
+    if live:
+        def r2a(mb):
+            mb.beta = mb.transcript.get_and_append_challenge(b"beta")
+            mb.gamma = mb.transcript.get_and_append_challenge(b"gamma")
+            mb.product_h = backend.perm_product(mb.ckt, mb.beta, mb.gamma, n)
+        each_live(r2a)
+    if live:
+        prods = backend.ifft_many(domain, [mb.product_h for mb in live])
+        for mb, pc in zip(live, prods):
+            mb.perm_coeffs = pc
+
+        def r2b(mb):
+            mb.permutation_poly = backend.blind(mb.perm_coeffs,
+                                                _rand(mb.rng, 3), n)
+        each_live(r2b)
+    if live:
+        comms = commit_many(ck, [mb.permutation_poly for mb in live])
+        for mb, c in zip(live, comms):
+            mb.prod_perm_poly_comm = c
+
+        def r2c(mb):
+            mb.transcript.append_commitment(b"perm_poly_comms",
+                                            mb.prod_perm_poly_comm)
+            if mb.checkpoint is not None:
+                mb.ck_arrays["permutation_poly"] = mb.permutation_poly
+                mb.ck_meta["beta"] = hex(mb.beta)
+                mb.ck_meta["gamma"] = hex(mb.gamma)
+                mb.ck_meta["prod_perm_poly_comm"] = \
+                    _point_enc(mb.prod_perm_poly_comm)
+            member_save(mb, 2)
+        each_live(r2c)
+        mark_round("round2", w0, time.perf_counter() - p0)
+
+    release = getattr(backend, "release_circuit_tables", None)
+    if release is not None:
+        for mb in live:
+            release(mb.ckt)
+
+    # --- Round 3: quotient polynomial (per-member pipeline, one commit) -----
+    w0, p0 = time.time(), time.perf_counter()
+    if live:
+        pis = backend.ifft_many(
+            domain, [backend.lift(mb.pub + [0] * (n - len(mb.pub)))
+                     for mb in live])
+        for mb, pi in zip(live, pis):
+            mb.pi_coeffs = pi
+        stream = getattr(backend, "quotient_streamed", None)
+        stream_poly = getattr(backend, "quotient_poly_streamed", None)
+
+        def r3(mb):
+            mb.alpha = mb.transcript.get_and_append_challenge(b"alpha")
+            asdn = (mb.alpha * mb.alpha % R_MOD
+                    * fr_inv(n % R_MOD) % R_MOD)
+            if stream_poly is not None:
+                quotient_poly = stream_poly(
+                    n, m, quot_domain, pk.vk.k, mb.beta, mb.gamma,
+                    mb.alpha, asdn, sel_h, sigma_h, mb.wire_polys,
+                    mb.permutation_poly, mb.pi_coeffs)
+            elif stream is not None:
+                quot_evals = stream(
+                    n, m, quot_domain, pk.vk.k, mb.beta, mb.gamma,
+                    mb.alpha, asdn, sel_h, sigma_h, mb.wire_polys,
+                    mb.permutation_poly, mb.pi_coeffs)
+                quotient_poly = backend.coset_ifft_h(quot_domain,
+                                                     quot_evals)
+            else:
+                batch = backend.coset_fft_many(
+                    quot_domain,
+                    list(sel_h) + list(sigma_h) + mb.wire_polys
+                    + [mb.permutation_poly, mb.pi_coeffs])
+                ns, nw = len(sel_h), num_wire_types
+                quot_evals = backend.quotient(
+                    n, m, quot_domain, pk.vk.k, mb.beta, mb.gamma,
+                    mb.alpha, asdn, batch[:ns], batch[ns:ns + nw],
+                    batch[ns + nw:ns + 2 * nw], batch[ns + 2 * nw],
+                    batch[ns + 2 * nw + 1])
+                quotient_poly = backend.coset_ifft_h(quot_domain,
+                                                     quot_evals)
+            expected_degree = num_wire_types * (n + 1) + 2
+            assert backend.degree_is(quotient_poly, expected_degree), \
+                expected_degree
+            mb.split_quot_polys = backend.split(
+                quotient_poly, n + 2, num_wire_types, expected_degree + 1)
+        each_live(r3)
+    if live:
+        comms = commit_many(ck, [h for mb in live
+                                 for h in mb.split_quot_polys])
+        for j, mb in enumerate(live):
+            mb.split_quot_poly_comms = \
+                comms[num_wire_types * j:num_wire_types * (j + 1)]
+
+        def r3b(mb):
+            mb.transcript.append_commitments(b"quot_poly_comms",
+                                             mb.split_quot_poly_comms)
+            if mb.checkpoint is not None:
+                mb.ck_arrays.update({
+                    "split_quot_poly_%d" % i: h
+                    for i, h in enumerate(mb.split_quot_polys)})
+                mb.ck_meta["alpha"] = hex(mb.alpha)
+                mb.ck_meta["split_quot_poly_comms"] = [
+                    _point_enc(p) for p in mb.split_quot_poly_comms]
+            member_save(mb, 3)
+        each_live(r3b)
+        mark_round("round3", w0, time.perf_counter() - p0)
+
+    # --- Round 4: evaluations (one launch across all members) ---------------
+    w0, p0 = time.time(), time.perf_counter()
+    if live:
+        def r4a(mb):
+            mb.zeta = mb.transcript.get_and_append_challenge(b"zeta")
+        each_live(r4a)
+    if live:
+        pairs = []
+        for mb in live:
+            pairs.extend(
+                [(w, mb.zeta) for w in mb.wire_polys]
+                + [(s, mb.zeta) for s in sigma_h[:num_wire_types - 1]]
+                + [(mb.permutation_poly,
+                    mb.zeta * domain.group_gen % R_MOD)])
+        evals = backend.eval_many_h(pairs)
+        per = 2 * num_wire_types  # 5 wires + 4 sigmas + z_next
+        for j, mb in enumerate(live):
+            ev = evals[per * j:per * (j + 1)]
+            mb.wires_evals = ev[:num_wire_types]
+            mb.wire_sigma_evals = ev[num_wire_types:2 * num_wire_types - 1]
+            mb.perm_next_eval = ev[-1]
+
+        def r4b(mb):
+            mb.transcript.append_proof_evaluations(
+                mb.wires_evals, mb.wire_sigma_evals, mb.perm_next_eval)
+            if mb.checkpoint is not None:
+                mb.ck_meta["zeta"] = hex(mb.zeta)
+                mb.ck_meta["wires_evals"] = [hex(v) for v in mb.wires_evals]
+                mb.ck_meta["wire_sigma_evals"] = [
+                    hex(v) for v in mb.wire_sigma_evals]
+                mb.ck_meta["perm_next_eval"] = hex(mb.perm_next_eval)
+            member_save(mb, 4)
+        each_live(r4b)
+        mark_round("round4", w0, time.perf_counter() - p0)
+
+    # --- Round 5: linearization + openings (one commit launch) --------------
+    w0, p0 = time.time(), time.perf_counter()
+    if live:
+        def r5a(mb):
+            vanish_eval = (pow(mb.zeta, n, R_MOD) - 1) % R_MOD
+            lin_poly = _linearization_poly(
+                backend, pk, sel_h, sigma_h, n, mb.beta, mb.gamma,
+                mb.alpha, mb.zeta, vanish_eval, mb.wires_evals,
+                mb.wire_sigma_evals, mb.perm_next_eval,
+                mb.permutation_poly, mb.split_quot_polys)
+            v = mb.transcript.get_and_append_challenge(b"v")
+            polys = ([lin_poly] + mb.wire_polys
+                     + sigma_h[:num_wire_types - 1])
+            coeffs = []
+            c = 1
+            for _ in polys:
+                coeffs.append(c)
+                c = c * v % R_MOD
+            batch_poly = backend.lin_comb_h(polys, coeffs)
+            mb.witness_poly = backend.synth_div_h(batch_poly, mb.zeta)
+            mb.shifted_witness_poly = backend.synth_div_h(
+                mb.permutation_poly, mb.zeta * domain.group_gen % R_MOD)
+        each_live(r5a)
+    if live:
+        comms = commit_many(ck, [h for mb in live
+                                 for h in (mb.witness_poly,
+                                           mb.shifted_witness_poly)])
+        for j, mb in enumerate(live):
+            mb.opening_proof = comms[2 * j]
+            mb.shifted_opening_proof = comms[2 * j + 1]
+
+        def r5b(mb):
+            if mb.checkpoint is not None:
+                mb.checkpoint.clear()
+            proofs[mb.i] = Proof(
+                mb.wires_poly_comms, mb.prod_perm_poly_comm,
+                mb.split_quot_poly_comms, mb.opening_proof,
+                mb.shifted_opening_proof, mb.wires_evals,
+                mb.wire_sigma_evals, mb.perm_next_eval)
+        each_live(r5b)
+        mark_round("round5", w0, time.perf_counter() - p0)
+
+    return proofs, errors
 
 
 def _linearization_poly(backend, pk, sel_h, sigma_h, n, beta, gamma, alpha,

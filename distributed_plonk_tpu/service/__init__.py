@@ -6,12 +6,17 @@ per process, /root/reference/src/dispatcher2.rs:1218-1295):
 
     client --SUBMIT/STATUS/RESULT/METRICS/WARMUP--> server.ProofService
         -> queue.JobQueue          (priority, admission control, backpressure)
-        -> scheduler.Scheduler     (shape buckets: shared SRS/pk per bucket,
-                                    compatible jobs batched to amortize keys;
+        -> placement.PlacementScheduler
+                                   (shape buckets: shared SRS/pk per bucket,
                                     BucketCache tiers memory -> disk -> build
-                                    over the ../store artifact store)
+                                    over the ../store artifact store; then the
+                                    PLACEMENT decision — small jobs prove
+                                    data-parallel as one batched launch set,
+                                    big jobs shard over a leased submesh,
+                                    mid sizes take the per-job pool)
         -> pool.WorkerPool         (per-job timeout, bounded retry,
-                                    resume-from-checkpoint on worker death)
+                                    resume-from-checkpoint on worker death;
+                                    batched groups via prover.prove_many)
         -> metrics.Metrics         (counters + latency histograms, JSON)
 
 The wire control plane rides runtime/protocol.py's framed transport (tags
@@ -26,6 +31,7 @@ from .jobs import Job, JobSpec, build_circuit, build_bucket_keys, shape_key
 from .journal import JobJournal
 from .queue import JobQueue, Rejected
 from .metrics import Metrics
+from .placement import PlacementScheduler, SubmeshLeaser
 from .pool import WorkerPool, WorkerKilled, JobTimeout, WorkerDrained
 from .scheduler import BucketCache, Scheduler
 from .server import ProofService
@@ -35,5 +41,6 @@ __all__ = [
     "Job", "JobSpec", "build_circuit", "build_bucket_keys", "shape_key",
     "JobJournal", "JobQueue", "Rejected", "Metrics", "WorkerPool",
     "WorkerKilled", "JobTimeout", "WorkerDrained", "BucketCache",
-    "Scheduler", "ProofService", "ServiceClient",
+    "Scheduler", "PlacementScheduler", "SubmeshLeaser", "ProofService",
+    "ServiceClient",
 ]

@@ -223,6 +223,10 @@ class Job:
         self.worker = None
         self.batch_id = None
         self.batch_size = None
+        # placement verdict (service/placement.py): "batch" (data-parallel
+        # cross-job prove), "mesh" (sharded submesh prove), or "pool"
+        # (per-job worker dispatch — also the base scheduler's only mode)
+        self.placement = None
         self.error = None
         self.proof_bytes = None
         self.public_input = None
@@ -291,6 +295,7 @@ class Job:
             "worker": self.worker,
             "batch_id": self.batch_id,
             "batch_size": self.batch_size,
+            "placement": self.placement,
             "wait_s": round(self.wait_s, 6),
             "run_s": None if self.run_s is None else round(self.run_s, 6),
             "rounds": {k: round(v, 6) for k, v in self.round_totals.items()},

@@ -37,6 +37,30 @@ Job lifecycle (service/server.py, service/pool.py, service/queue.py):
 Scheduler + shape buckets (service/scheduler.py):
     batches_dispatched / batch_size                  shape-batch activity
     dispatch_errors                                  pool handoff failures
+
+Placement + cross-job batched proving (service/placement.py, pool.py):
+    placement_*                                      decisions per popped
+                                                     shape batch: _batch
+                                                     (data-parallel cross-
+                                                     job prove), _mesh
+                                                     (sharded submesh
+                                                     prove), _pool (per-job
+                                                     dispatch)
+    batch_proves                                     batched prove_many
+                                                     attempts launched
+    batch_jobs                                       jobs proved inside
+                                                     batched attempts
+    batch_jobs_per_launch (histogram)                achieved jobs per
+                                                     batched attempt
+    batch_member_kills                               batch members killed
+                                                     mid-prove (resumed
+                                                     alone; the others
+                                                     finished unaffected)
+    submesh_leases                                   device leases granted
+                                                     (big sharded proves +
+                                                     opportunistic batch
+                                                     leases)
+    submesh_devices_free (gauge)                     unleased devices
     bucket_hits / bucket_misses / bucket_disk_hits   key-cache tiers
     bucket_peer_hits                                 keys fetched from a
                                                      warm STORE_FETCH peer

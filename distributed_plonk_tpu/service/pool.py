@@ -1,11 +1,18 @@
 """Prover worker pool: per-job timeout, bounded retry, checkpoint resume.
 
-Each worker owns a backend instance and proves one job at a time. Every
-attempt runs with a `checkpoint.ProverCheckpoint` under the job's id, so
-when a worker dies mid-prove the retry does NOT restart at round 1: it
-resumes at the last completed round with the identical transcript/RNG
-state and produces the same bytes the uninterrupted run would have
-(tests/test_checkpoint.py pins that contract; this module is its consumer).
+Each worker owns a backend instance and proves one DISPATCH UNIT at a
+time: a single job, or — from the placement layer — a GROUP
+(`dispatch_group`): N same-shape jobs proved together through
+`prover.prove_many` (cross-job batched kernel launches, byte-identical
+to sequential), or one job on an override backend (a leased-submesh
+MeshBackend). Every attempt runs with a `checkpoint.ProverCheckpoint`
+under the job's id, so when a worker dies mid-prove the retry does NOT
+restart at round 1: it resumes at the last completed round with the
+identical transcript/RNG state and produces the same bytes the
+uninterrupted run would have (tests/test_checkpoint.py pins that
+contract; this module is its consumer). In a group, failure is
+member-scoped: a killed batch member retries ALONE (resuming from its
+snapshot) while the survivors finish in the original batch.
 
 Failure semantics:
 - worker kill (fault injection / crash analog): the worker thread dies and
@@ -29,7 +36,7 @@ import time
 import queue as _stdlib_queue
 
 from ..checkpoint import ProverCheckpoint, StoreCheckpoint
-from ..prover import prove
+from ..prover import prove, prove_many
 from ..proof_io import serialize_proof
 from ..trace import Tracer
 from . import jobs as J
@@ -74,7 +81,7 @@ class _GuardHooks:
         return self
 
     def load(self, fingerprint):
-        self.worker.check(round_no=0)
+        self.worker.check(round_no=0, job_id=self._job_id)
         state = super().load(fingerprint)
         if state is not None and self._metrics is not None:
             # a non-None load means this attempt RESUMES mid-prove
@@ -93,7 +100,10 @@ class _GuardHooks:
             self._journal.append(JN.ROUND, self._job_id, round=round_no)
         if self._faults is not None:
             self._faults.on_round(round_no, checkpoint=self)
-        self.worker.check(round_no=round_no)
+        # job_id rides along so a job-targeted kill in a BATCHED prove
+        # fires on exactly its member's boundary (the other members'
+        # guards pass through unharmed)
+        self.worker.check(round_no=round_no, job_id=self._job_id)
 
 
 class _GuardedCheckpoint(_GuardHooks, ProverCheckpoint):
@@ -121,19 +131,24 @@ class _Worker:
         self.index = index
         self.generation = generation
         self.name = f"w{index}g{generation}"
-        self.kill_arm = None       # None | {"at_round": int|None}
+        # None | {"at_round": int|None, "job_id": str|None}: a job_id-
+        # scoped arm (set when the kill targeted a specific job inside a
+        # BATCHED prove) fires only on that member's round boundaries
+        self.kill_arm = None
         self.deadline = None
-        self.busy_job = None
+        self.busy_jobs = []        # jobs this slot is proving right now
         self.thread = None
         # pool-wide forced-drain flag: set once the drain deadline passes,
         # observed here at round boundaries (the snapshot just became
         # durable — the cheapest possible point to stop)
         self.drain_stop = drain_stop
 
-    def check(self, round_no=None):
+    def check(self, round_no=None, job_id=None):
         arm = self.kill_arm
         if arm is not None and (arm["at_round"] is None
-                                or arm["at_round"] == round_no):
+                                or arm["at_round"] == round_no) \
+                and (arm.get("job_id") is None
+                     or arm["job_id"] == job_id):
             self.kill_arm = None
             raise WorkerKilled(self.name)
         if self.drain_stop is not None and self.drain_stop.is_set():
@@ -145,14 +160,36 @@ class _Worker:
 _STOP = object()
 
 
+class _Group:
+    """One placement unit on the dispatch queue (see
+    WorkerPool.dispatch_group): jobs + shared resources, an optional
+    backend override (leased-submesh MeshBackend), and the lease-release
+    callback that must run when the attempt ends."""
+
+    __slots__ = ("jobs", "res", "backend", "lease", "release")
+
+    def __init__(self, jobs, res, backend, lease, release):
+        self.jobs = jobs
+        self.res = res
+        self.backend = backend
+        self.lease = lease
+        self.release = release
+
+
 class WorkerPool:
     def __init__(self, metrics, prover_workers=2, max_retries=2,
                  job_timeout_s=None, ckpt_dir=None, backend_factory=None,
                  verify_on_complete=False, store=None, faults=None,
-                 journal=None):
+                 journal=None, requeue=None):
         self.metrics = metrics
         self.max_retries = max_retries
         self.job_timeout_s = job_timeout_s
+        # requeue: the admission JobQueue (set by ProofService) — a
+        # retried MESH-placed job goes back through the scheduler for
+        # RE-PLACEMENT (fresh lease + sharded backend) instead of
+        # retrying on this worker's shared single-device backend, which
+        # is exactly the memory/latency ceiling mesh placement avoids
+        self._requeue = requeue
         # checkpoint surface: with a store, snapshots are content-addressed
         # store artifacts (one durability surface + one eviction policy,
         # and a replacement host can STORE_FETCH them); the ckpt-dir file
@@ -218,10 +255,10 @@ class WorkerPool:
         self._drain_stop.set()
 
     def busy(self):
-        """Names of workers currently holding a job."""
+        """Names of workers currently holding at least one job."""
         with self._lock:
             pool = list(self._workers)
-        return [w.name for w in pool if w.busy_job is not None]
+        return [w.name for w in pool if w.busy_jobs]
 
     def drain(self, deadline):
         """Graceful drain: let in-flight proves finish until `deadline`
@@ -255,25 +292,44 @@ class WorkerPool:
         """Hand a scheduled job to the pool (blocks for backpressure)."""
         self._dispatch_q.put((job, resources))
 
+    def dispatch_group(self, jobs, resources, backend=None, lease=None,
+                       release=None):
+        """Hand one PLACEMENT UNIT to the pool (blocks for backpressure):
+        N same-shape jobs proved together by one worker through
+        prover.prove_many (the data-parallel small-job class), or a
+        single job with a `backend` override (a sharded MeshBackend over
+        a leased submesh). `release(lease)` runs when the group's attempt
+        ends — success, member failure, or drain — so submesh devices
+        always return to the leaser."""
+        self._dispatch_q.put(_Group(list(jobs), resources, backend,
+                                    lease, release))
+
     def kill_worker(self, worker=None, job_id=None, at_round=None):
         """Fault injection: arm a kill on a specific worker, on whichever
         worker is proving `job_id`, or on any busy (else any) worker.
-        Returns the victim's name; raises LookupError if no match."""
+        Returns the victim's name; raises LookupError if no match.
+
+        A job-targeted kill is scoped to that JOB: on a worker running a
+        batched prove only the targeted member dies (it resumes alone
+        from its snapshot; the other members finish unaffected) — on a
+        single-job worker the semantics are the historical thread kill."""
         with self._lock:
             pool = list(self._workers)
         victim = None
+        arm_job = None
         if worker is not None:
             victim = next((w for w in pool if w.name == worker), None)
         elif job_id is not None:
             victim = next((w for w in pool
-                           if w.busy_job is not None
-                           and w.busy_job.id == job_id), None)
+                           if any(j.id == job_id for j in w.busy_jobs)),
+                          None)
+            arm_job = job_id
         else:
-            victim = next((w for w in pool if w.busy_job is not None),
+            victim = next((w for w in pool if w.busy_jobs),
                           pool[0] if pool else None)
         if victim is None:
             raise LookupError("no such worker/job to kill")
-        victim.kill_arm = {"at_round": at_round}
+        victim.kill_arm = {"at_round": at_round, "job_id": arm_job}
         self.metrics.inc("kill_requests")
         return victim.name
 
@@ -319,11 +375,108 @@ class WorkerPool:
             item = self._dispatch_q.get()
             if item is _STOP:
                 return
+            if isinstance(item, _Group):
+                try:
+                    if len(item.jobs) == 1:
+                        # single-job group (a leased-submesh sharded
+                        # prove): the historical single-attempt path,
+                        # just on the override backend
+                        alive = self._run_one(worker,
+                                              item.backend or backend,
+                                              item.jobs[0], item.res)
+                    else:
+                        alive = self._run_group(worker,
+                                                item.backend or backend,
+                                                item.jobs, item.res)
+                finally:
+                    if item.release is not None:
+                        item.release(item.lease)
+                if not alive:
+                    return
+                continue
             job, res = item
+            if not self._run_one(worker, backend, job, res):
+                return
+
+    def _run_one(self, worker, backend, job, res):
+        """One single-job attempt on this worker thread. Returns False
+        when the thread must exit (killed slot — already respawned — or
+        drain)."""
+        if job.expired():
+            self.shed(job, "ttl expired before prove start")
+            return True
+        worker.busy_jobs = [job]
+        if job.started_at is None:
+            job.started_at = time.monotonic()
+            self.metrics.observe("job_wait", job.wait_s)
+        job.worker = worker.name
+        job.state = J.RUNNING
+        if self.journal is not None:
+            self.journal.append(JN.START, job.id, worker=worker.name)
+        try:
+            self._run_attempt(worker, backend, job, res)
+            job.attempts.append({"worker": worker.name, "outcome": "ok"})
+            self.metrics.inc("jobs_completed")
+            self.metrics.observe("job_run", job.run_s)
+        except WorkerDrained:
+            # deadline-forced drain: the round snapshot is durable and
+            # the job's journal entry still reads in-flight — park it
+            # (no requeue, no terminal record); the restarted service
+            # resumes it from the checkpoint
+            job.attempts.append({"worker": worker.name,
+                                 "outcome": "drained"})
+            job.state = J.QUEUED
+            job.worker = None
+            worker.busy_jobs = []
+            self.metrics.inc("jobs_drain_parked")
+            return False  # draining: this thread is done
+        except WorkerKilled:
+            job.attempts.append({"worker": worker.name,
+                                 "outcome": "killed"})
+            self.metrics.inc("workers_killed")
+            worker.busy_jobs = []
+            # replacement first: with a 1-worker pool the requeue below
+            # can block on a full dispatch queue until someone consumes
+            self._respawn(worker)
+            self._retry_or_fail(job, res, "worker killed mid-prove")
+            return False  # this thread is the "dead process"
+        except JobTimeout:
+            job.attempts.append({"worker": worker.name,
+                                 "outcome": "timeout"})
+            self.metrics.inc("jobs_timeout")
+            self._fail(job, f"timeout after {self.job_timeout_s}s")
+        except Exception as e:  # prove/verify error: bounded retry
+            job.attempts.append({"worker": worker.name,
+                                 "outcome": f"error: {e!r}"})
+            self.metrics.inc("job_attempt_errors")
+            self._retry_or_fail(job, res, f"prove failed: {e!r}")
+        finally:
+            worker.busy_jobs = []
+            # a kill that armed too late to fire on its target (e.g.
+            # during round 5, past the last boundary check) must not
+            # leak onto the worker's next, unrelated job
+            worker.kill_arm = None
+        return True
+
+    def _run_group(self, worker, backend, jobs, res):
+        """One data-parallel batch attempt: N same-shape jobs proved
+        together through prover.prove_many on this worker's backend,
+        cross-job kernel launches batched, proof bytes byte-identical to
+        N sequential attempts. Member failures are isolated: a killed /
+        timed-out / erroring member is retried or failed ALONE (its
+        snapshot is durable; the retry resumes it through the sequential
+        path) while the surviving members complete in this very call.
+        Returns False when the pool is draining (thread exits)."""
+        live = []
+        for job in jobs:
             if job.expired():
                 self.shed(job, "ttl expired before prove start")
-                continue
-            worker.busy_job = job
+            else:
+                live.append(job)
+        if not live:
+            return True
+        worker.busy_jobs = list(live)
+        for job in live:
             if job.started_at is None:
                 job.started_at = time.monotonic()
                 self.metrics.observe("job_wait", job.wait_s)
@@ -331,49 +484,78 @@ class WorkerPool:
             job.state = J.RUNNING
             if self.journal is not None:
                 self.journal.append(JN.START, job.id, worker=worker.name)
-            try:
-                self._run_attempt(worker, backend, job, res)
-                job.attempts.append({"worker": worker.name, "outcome": "ok"})
-                self.metrics.inc("jobs_completed")
-                self.metrics.observe("job_run", job.run_s)
-            except WorkerDrained:
-                # deadline-forced drain: the round snapshot is durable and
-                # the job's journal entry still reads in-flight — park it
-                # (no requeue, no terminal record); the restarted service
-                # resumes it from the checkpoint
+        self.metrics.inc("batch_proves")
+        self.metrics.inc("batch_jobs", len(live))
+        self.metrics.observe("batch_jobs_per_launch", len(live))
+        tracers = [self._job_tracer(worker, job) for job in live]
+        ckts = [J.build_circuit(job.spec) for job in live]
+        guards = [self._make_guard(job, worker) for job in live]
+        rngs = [random.Random(job.spec.seed) for job in live]
+        if self.job_timeout_s is not None:
+            worker.deadline = (min(j.started_at for j in live)
+                               + self.job_timeout_s)
+        try:
+            proofs, errors = prove_many(rngs, ckts, res.pk, backend,
+                                        tracers=tracers, checkpoints=guards,
+                                        abort_on=(WorkerDrained,))
+        except WorkerDrained:
+            # drain aborts the whole batch: every member parks in-flight
+            # (snapshots durable, journal unchanged) — the restarted
+            # service resumes or re-proves deterministically
+            for job in live:
                 job.attempts.append({"worker": worker.name,
                                      "outcome": "drained"})
                 job.state = J.QUEUED
                 job.worker = None
-                worker.busy_job = None
                 self.metrics.inc("jobs_drain_parked")
-                return  # draining: this thread is done
-            except WorkerKilled:
+            worker.busy_jobs = []
+            return False
+        except Exception as e:  # batch-wide infrastructure failure
+            for job in live:
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": f"error: {e!r}"})
+                self.metrics.inc("job_attempt_errors")
+                self._retry_or_fail(job, res, f"batch prove failed: {e!r}")
+            worker.busy_jobs = []
+            worker.kill_arm = None
+            return True
+        finally:
+            worker.deadline = None
+        for job, tracer, ckt, proof, err in zip(live, tracers, ckts,
+                                                proofs, errors):
+            if proof is not None:
+                try:
+                    self._finish_proved(job, res, ckt, proof, tracer)
+                    job.attempts.append({"worker": worker.name,
+                                         "outcome": "ok"})
+                    self.metrics.inc("jobs_completed")
+                    self.metrics.observe("job_run", job.run_s)
+                except Exception as e:  # verify/journal failure
+                    job.attempts.append({"worker": worker.name,
+                                         "outcome": f"error: {e!r}"})
+                    self.metrics.inc("job_attempt_errors")
+                    self._retry_or_fail(job, res, f"prove failed: {e!r}")
+            elif isinstance(err, WorkerKilled):
+                # job-scoped kill: only this member died; it resumes
+                # ALONE from its snapshot via the single-job retry path
                 job.attempts.append({"worker": worker.name,
                                      "outcome": "killed"})
-                self.metrics.inc("workers_killed")
-                worker.busy_job = None
-                # replacement first: with a 1-worker pool the requeue below
-                # can block on a full dispatch queue until someone consumes
-                self._respawn(worker)
-                self._retry_or_fail(job, res, "worker killed mid-prove")
-                return  # this thread is the "dead process"
-            except JobTimeout:
+                self.metrics.inc("batch_member_kills")
+                self._retry_or_fail(job, res,
+                                    "batch member killed mid-prove")
+            elif isinstance(err, JobTimeout):
                 job.attempts.append({"worker": worker.name,
                                      "outcome": "timeout"})
                 self.metrics.inc("jobs_timeout")
                 self._fail(job, f"timeout after {self.job_timeout_s}s")
-            except Exception as e:  # prove/verify error: bounded retry
+            else:
                 job.attempts.append({"worker": worker.name,
-                                     "outcome": f"error: {e!r}"})
+                                     "outcome": f"error: {err!r}"})
                 self.metrics.inc("job_attempt_errors")
-                self._retry_or_fail(job, res, f"prove failed: {e!r}")
-            finally:
-                worker.busy_job = None
-                # a kill that armed too late to fire on its target (e.g.
-                # during round 5, past the last boundary check) must not
-                # leak onto the worker's next, unrelated job
-                worker.kill_arm = None
+                self._retry_or_fail(job, res, f"prove failed: {err!r}")
+        worker.busy_jobs = []
+        worker.kill_arm = None
+        return True
 
     def _retry_or_fail(self, job, res, reason):
         job.retries += 1
@@ -382,6 +564,17 @@ class WorkerPool:
             return
         self.metrics.inc("job_retries")
         job.state = J.QUEUED
+        if job.placement == "mesh" and self._requeue is not None:
+            # back through the scheduler: the retry must be RE-PLACED on
+            # a fresh submesh lease (the snapshot still resumes it — the
+            # checkpoint is keyed by job id, not by backend)
+            job.worker = None
+            job.placement = None
+            try:
+                self._requeue.submit(job, force=True)
+                return
+            except Exception:  # queue closed (drain/shutdown): fall back
+                pass           # to the in-pool retry below
         # snapshot stays in place: the retry resumes, not restarts.
         # NEVER block a worker thread on the requeue: workers are the
         # dispatch queue's consumers, so a blocking put from one with the
@@ -400,20 +593,49 @@ class WorkerPool:
             self.journal.append(JN.FAILED, job.id, reason=reason)
         job.finish_err(reason)
 
+    def _job_tracer(self, worker, job):
+        """The prover traces under the JOB's id (stamped/adopted at
+        SUBMIT), parented to the client's span when one was propagated —
+        every retry attempt re-records from scratch, so the stored
+        timeline is the attempt that produced the proof plus the queue
+        wait that preceded it. The queued span carries the PLACEMENT
+        decision as attrs (placement class + shape-batch size), so the
+        trace timeline shows how the scheduler routed the job."""
+        tracer = Tracer(trace_id=job.trace_id,
+                        parent_id=job.trace_parent,
+                        proc=f"pool/{worker.name}")
+        tracer.add_event("service/queued", ts=job.submitted_wall,
+                         dur_s=job.wait_s, job_id=job.id,
+                         placement=job.placement,
+                         batch_size=job.batch_size)
+        return tracer
+
+    def _finish_proved(self, job, res, ckt, proof, tracer):
+        """Post-prove completion shared by the single and batched paths:
+        optional server-side verify, round/kernel metrics, finished-proof
+        durability, trace artifact, client-visible done."""
+        if self.verify_on_complete:
+            from ..verifier import verify
+            assert verify(res.vk, ckt.public_input(), proof,
+                          rng=random.Random(1)), \
+                "proof failed server-side verification"
+        totals = tracer.totals(depth=1)
+        self.metrics.observe_rounds(totals)
+        # kernel spans carry flops attrs (prover.py): fold them into
+        # live per-stage MFU/throughput gauges — the serving-path
+        # replacement for bench-only MFU numbers
+        self.metrics.observe_kernels(tracer.events)
+        proof_bytes = serialize_proof(proof)
+        pub = ckt.public_input()
+        self._journal_done(job, proof_bytes, pub)
+        self._store_trace(job, tracer)
+        job.finish_ok(proof_bytes, pub, totals)
+
     def _run_attempt(self, worker, backend, job, res):
         if self.job_timeout_s is not None:
             worker.deadline = job.started_at + self.job_timeout_s
         try:
-            # the prover traces under the JOB's id (stamped/adopted at
-            # SUBMIT), parented to the client's span when one was
-            # propagated — every retry attempt re-records from scratch,
-            # so the stored timeline is the attempt that produced the
-            # proof plus the queue wait that preceded it
-            tracer = Tracer(trace_id=job.trace_id,
-                            parent_id=job.trace_parent,
-                            proc=f"pool/{worker.name}")
-            tracer.add_event("service/queued", ts=job.submitted_wall,
-                             dur_s=job.wait_s, job_id=job.id)
+            tracer = self._job_tracer(worker, job)
             ckt = J.build_circuit(job.spec)
             guard = self._make_guard(job, worker)
             try:
@@ -426,22 +648,7 @@ class WorkerPool:
                     # failing identically until retries are exhausted
                     guard.clear()
                 raise
-            if self.verify_on_complete:
-                from ..verifier import verify
-                assert verify(res.vk, ckt.public_input(), proof,
-                              rng=random.Random(1)), \
-                    "proof failed server-side verification"
-            totals = tracer.totals(depth=1)
-            self.metrics.observe_rounds(totals)
-            # kernel spans carry flops attrs (prover.py): fold them into
-            # live per-stage MFU/throughput gauges — the serving-path
-            # replacement for bench-only MFU numbers
-            self.metrics.observe_kernels(tracer.events)
-            proof_bytes = serialize_proof(proof)
-            pub = ckt.public_input()
-            self._journal_done(job, proof_bytes, pub)
-            self._store_trace(job, tracer)
-            job.finish_ok(proof_bytes, pub, totals)
+            self._finish_proved(job, res, ckt, proof, tracer)
         finally:
             worker.deadline = None
 

@@ -265,8 +265,30 @@ class Scheduler:
                 job.scheduled_at = time.monotonic()
                 job.batch_id = batch_id
                 job.batch_size = len(batch)
-                try:
-                    self.pool.dispatch(job, res)
-                except Exception as e:  # pragma: no cover - defensive
-                    self.metrics.inc("dispatch_errors")
-                    job.finish_err(f"dispatch failed: {e!r}")
+            try:
+                self._place(batch, res)
+            except Exception as e:  # pragma: no cover - defensive
+                # a job whose placement was never stamped was never
+                # handed to execution: fail it loudly instead of letting
+                # it hang queued forever (stamped jobs are owned by
+                # their dispatch unit — never double-finished here)
+                self.metrics.inc("dispatch_errors")
+                for job in batch:
+                    if job.placement is None:
+                        job.finish_err(f"dispatch failed: {e!r}")
+
+    def _place(self, batch, res):
+        """Hand one popped shape batch to execution. The base scheduler
+        dispatches every job individually onto the pool (the pre-
+        placement behavior); PlacementScheduler (service/placement.py)
+        overrides this with the classify/lease/batch logic. The
+        contract: `job.placement` is stamped exactly when the job is
+        handed to an execution unit."""
+        for job in batch:
+            job.placement = "pool"  # stamped before dispatch: the worker
+            # thread may read it for the trace attrs the moment it pops
+            try:
+                self.pool.dispatch(job, res)
+            except Exception as e:  # pragma: no cover - defensive
+                self.metrics.inc("dispatch_errors")
+                job.finish_err(f"dispatch failed: {e!r}")

@@ -29,9 +29,10 @@ from . import jobs as J
 from . import journal as JN
 from .jobs import Job, JobSpec
 from .metrics import Metrics
+from .placement import PlacementScheduler
 from .pool import WorkerPool
 from .queue import JobQueue, Rejected
-from .scheduler import BucketCache, Scheduler
+from .scheduler import BucketCache
 
 
 class ProofService:
@@ -41,7 +42,8 @@ class ProofService:
                  backend_factory=None, verify_on_complete=False,
                  finished_retention=4096, allow_remote_shutdown=False,
                  store_dir=None, store_byte_budget=None, bucket_cap=64,
-                 store_peers=None, faults=None, journal_dir=None):
+                 store_peers=None, faults=None, journal_dir=None,
+                 devices=None, mesh_backend_factory=None):
         self.host = host
         self.port = port
         self.chaos = chaos
@@ -83,7 +85,8 @@ class ProofService:
             max_retries=max_retries, job_timeout_s=job_timeout_s,
             ckpt_dir=ckpt_dir, backend_factory=backend_factory,
             verify_on_complete=verify_on_complete, store=self.store,
-            faults=self.faults, journal=self.journal)
+            faults=self.faults, journal=self.journal,
+            requeue=self.queue)
         # store_peers: [(host, port)] of peers speaking STORE_FETCH — a
         # bucket miss tries a network copy from a warm peer before paying
         # for a full key build (elastic scale-out: a fresh host serves
@@ -91,8 +94,16 @@ class ProofService:
         self.buckets = BucketCache(self.metrics, store=self.store,
                                    max_entries=bucket_cap,
                                    peers=store_peers)
-        self.scheduler = Scheduler(self.queue, self.pool, self.metrics,
-                                   buckets=self.buckets, max_batch=max_batch)
+        # placement-aware scheduling (service/placement.py): small shape
+        # buckets prove data-parallel (cross-job batched kernel launches,
+        # byte-identical to sequential), large ones shard over a leased
+        # submesh, mid sizes keep the per-job pool path. devices /
+        # mesh_backend_factory are test injection points; production
+        # enumerates jax.devices() lazily on the first lease.
+        self.scheduler = PlacementScheduler(
+            self.queue, self.pool, self.metrics, buckets=self.buckets,
+            max_batch=max_batch, devices=devices,
+            mesh_backend_factory=mesh_backend_factory)
         self._warm_backend = None
         self._warm_backend_lock = threading.Lock()
         self.jobs = {}

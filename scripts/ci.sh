@@ -25,7 +25,11 @@
 #                          restart, torn journal, TTL shed, SIGTERM drain),
 #                          PLUS the distributed-tracing suite: serve.py
 #                          subprocess obs endpoints, 3-process fleet prove
-#                          -> one merged trace artifact, wire back-compat
+#                          -> one merged trace artifact, wire back-compat,
+#                          PLUS the placement suite: batched-vs-sequential
+#                          byte-identity, submesh lease/release, batch
+#                          member kill-resume, mesh-retry re-placement,
+#                          DPT_BATCH_PROVE=0 parity
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
@@ -33,7 +37,7 @@ fi
 if [ "$1" = "chaos" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_runtime_faults.py tests/test_service_journal.py \
-    tests/test_trace.py tests/test_obs.py \
+    tests/test_trace.py tests/test_obs.py tests/test_placement.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "fast" ]; then

@@ -38,7 +38,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # mixed shapes: domains 32 / 128 / 256 (toy gate chains)
 _MIX = [{"kind": "toy", "gates": g} for g in (16, 60, 150)]
+# burst profile (--mix burst): ONE small shape for every job, submitted
+# concurrently — the traffic pattern the placement layer's data-parallel
+# batching exists for (same-shape jobs pop as one batch and prove
+# together; the summary reports the jobs-per-launch actually achieved)
+_BURST_MIX = [{"kind": "toy", "gates": 16}]
 _KILL_SPEC = {"kind": "toy", "gates": 300}  # n=512: wide kill window
+
+
+def _job_mix(args):
+    return _BURST_MIX if args.mix == "burst" else _MIX
 
 
 def _verify_result(header, blob, key_cache, lock):
@@ -114,9 +123,10 @@ def run_kill_service_soak(args):
     # arm the service kill at the Nth matching journal occurrence; the
     # job mix below guarantees ROUND records exist before it fires
     proc = spawn(faults=f"kill:at=journal:tag={args.kill_service}")
+    mix = _job_mix(args)
     specs = []
     for i in range(args.jobs):
-        spec = dict(_MIX[i % len(_MIX)])
+        spec = dict(mix[i % len(mix)])
         spec.update(seed=1000 + i, priority=i % 3,
                     job_key=f"soak-{args.chaos_seed}-{i}")
         specs.append(spec)
@@ -184,6 +194,13 @@ def main():
                     help="external server (default: self-hosted in-process)")
     ap.add_argument("--port", type=int, default=9555)
     ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--mix", choices=("mixed", "burst"), default="mixed",
+                    help="job-shape profile: 'mixed' rotates 3 toy "
+                         "domains (2^5..2^9); 'burst' submits ONE small "
+                         "shape for every job — same-shape traffic that "
+                         "actually exercises the placement layer's "
+                         "cross-job batched proving (see the summary's "
+                         "batch.jobs_per_launch)")
     ap.add_argument("--workers", type=int, default=2,
                     help="pool size for the self-hosted server")
     ap.add_argument("--store-dir", default=None,
@@ -274,9 +291,11 @@ def main():
                 return
             time.sleep(0.01)
 
+    mix = _job_mix(args)
+
     def submitter(i):
         from distributed_plonk_tpu.trace import Tracer
-        spec = dict(_MIX[i % len(_MIX)])
+        spec = dict(mix[i % len(mix)])
         spec.update(seed=1000 + i, priority=i % 3)
         out = {"index": i, "spec": spec}
         # each job is one end-to-end trace: the client's span is the
@@ -381,13 +400,28 @@ def main():
                             for k, v in ctr.items()
                             if k.startswith("faults_injected_")},
     }
+    batch_proves = ctr.get("batch_proves", 0)
+    batch_jobs = ctr.get("batch_jobs", 0)
     summary = {
         "ok": ok,
         "wall_s": round(time.time() - t0, 3),
         "jobs": args.jobs,
+        "mix": args.mix,
         "verified": verified,
         "failed": [r for r in results if not r.get("verified")],
         "kill": kill_report,
+        # placement + cross-job batching achieved by this run's traffic:
+        # jobs_per_launch is the amortization the burst profile exists
+        # to demonstrate (1.0 means nothing ever batched)
+        "batch": {
+            "proves": batch_proves,
+            "jobs": batch_jobs,
+            "jobs_per_launch": (round(batch_jobs / batch_proves, 2)
+                                if batch_proves else None),
+            "member_kills": ctr.get("batch_member_kills", 0),
+            "placement": {k: v for k, v in sorted(ctr.items())
+                          if k.startswith("placement_")},
+        },
         # chaos soak report: what was injected, what the service survived
         # (every proof above still had to verify for ok=true)
         "chaos": {

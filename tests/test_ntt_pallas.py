@@ -292,11 +292,21 @@ def test_round3_fusion_matches_unfused():
     args = (n, m, qd, k, beta, gamma, alpha, asdn, sel, sig, wir, zpoly, pi)
 
     saved = JB._R3_FUSE
+    saved_br = JB._R3_BITREV
     try:
         JB._R3_FUSE = True
         fused = np.asarray(JB.JaxBackend().quotient_poly_streamed(*args))
         JB._R3_FUSE = False
         unfused = np.asarray(JB.JaxBackend().quotient_poly_streamed(*args))
+        # DPT_R3_BITREV: the deferred-bit-reversal pipeline (producer
+        # launches emit constant-geometry order, tables re-indexed, one
+        # input gather at the consuming iNTT) must be bit-identical to
+        # BOTH the per-launch-permuted fused path and the unfused path
+        JB._R3_FUSE = True
+        JB._R3_BITREV = not saved_br
+        flipped = np.asarray(JB.JaxBackend().quotient_poly_streamed(*args))
     finally:
         JB._R3_FUSE = saved
+        JB._R3_BITREV = saved_br
     assert np.array_equal(fused, unfused)
+    assert np.array_equal(fused, flipped)
