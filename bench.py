@@ -864,6 +864,100 @@ def fleet_chaos_main():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def fleet_heal_main():
+    """The self-healing regression canary (ISSUE 12): 3 SUPERVISED
+    worker processes under dynamic membership, one SIGKILLed mid-FFT1 by
+    the `kill:at=proc` chaos plane. Measures the heal: time from the
+    SIGKILL to the fleet restored at FULL width (supervisor respawn ->
+    JOIN re-admission -> all members probing healthy), with the
+    recovered proof byte-identical to the host oracle's. Prints one JSON
+    line ({fleet_healed_ok, fleet_heal_s, ...}); entirely jax-free."""
+    import random as _random
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.runtime import protocol
+    from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                          RemoteBackend)
+    from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
+    from distributed_plonk_tpu.runtime.health import LivenessTracker
+    from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+    from distributed_plonk_tpu.runtime.supervisor import WorkerSupervisor
+    from distributed_plonk_tpu.service.jobs import JobSpec, build_circuit, \
+        build_bucket_keys
+    from distributed_plonk_tpu.service.metrics import Metrics
+
+    spec = JobSpec.from_wire({"kind": "toy", "gates": 16, "seed": 7})
+    ckt = build_circuit(spec)
+    _srs, pk, _vk = build_bucket_keys(spec)
+    proof_host = prove(_random.Random(1), ckt, pk, PythonBackend())
+
+    n_workers = 3
+    metrics = Metrics()
+    kill_at = []
+    faults = FaultInjector(
+        [Rule("kill", tag=protocol.FFT1, worker=1, nth=1, plane="proc")],
+        metrics=metrics)
+    d = Dispatcher(NetworkConfig([]), metrics=metrics, faults=faults)
+    d.tracker = LivenessTracker(0, breaker_k=2, probe_base_s=0.05,
+                                probe_max_s=0.5, metrics=metrics)
+    mserver = d.enable_membership()
+    sup = WorkerSupervisor("127.0.0.1", mserver.port, n=n_workers,
+                           backend="python", metrics=metrics,
+                           cwd=REPO).start()
+    proc_kill = sup.proc_killer(d)
+
+    def stamped_kill(i):
+        kill_at.append(time.perf_counter())
+        proc_kill(i)
+    faults.proc_kill_cb = stamped_kill
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(d.workers) == n_workers \
+                    and len(d.tracker.usable_set()) == n_workers:
+                break
+            time.sleep(0.1)
+        for w in d.workers:
+            w.RECONNECT_TRIES = 2
+            w.BACKOFF_BASE_S = 0.01
+            w.BACKOFF_MAX_S = 0.05
+        proof = prove(_random.Random(1), ckt, pk,
+                      RemoteBackend(d, dist_fft_min=ckt.n))
+        proof_ok = (proof.opening_proof == proof_host.opening_proof
+                    and proof.shifted_opening_proof
+                    == proof_host.shifted_opening_proof
+                    and proof.wires_poly_comms == proof_host.wires_poly_comms)
+
+        healed = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(d.tracker.usable_set()) == n_workers and all(
+                    w.probe(timeout_ms=2000) is not None
+                    for w in d.workers):
+                healed = True
+                break
+            time.sleep(0.1)
+        heal_s = (time.perf_counter() - kill_at[0]) if kill_at else None
+        ctr = metrics.snapshot()["counters"]
+        print(json.dumps({
+            "fleet_healed_ok": bool(
+                proof_ok and healed and kill_at
+                and ctr.get("worker_respawns", 0) >= 1
+                and ctr.get("membership_rejoins", 0) >= 1),
+            "fleet_heal_s": round(heal_s, 3) if heal_s is not None else None,
+            "fleet_heal_phase": "proc-kill@FFT1",
+            "fleet_heal_epoch": d.epoch,
+            "fleet_heal_counters": {
+                k: v for k, v in sorted(ctr.items())
+                if k.startswith(("membership_", "worker_", "warm_",
+                                 "fleet_", "faults_"))},
+        }))
+    finally:
+        sup.stop()
+        d.shutdown()
+        d.pool.shutdown(wait=False)
+
+
 # --- outer harness (no jax imports past this line) ---------------------------
 
 def _probe_device(timeout_s):
@@ -1002,6 +1096,28 @@ def _measure_fleet_chaos():
                 "fleet_chaos_error": repr(e)}
 
 
+def _measure_fleet_heal():
+    """Run fleet_heal_main in a scrubbed-CPU subprocess; returns its keys
+    or {fleet_healed_ok: False, fleet_heal_error} — every bench line
+    records whether a SIGKILLed supervised worker is respawned, rejoins,
+    and the fleet heals to full width with byte-identical proof bytes.
+    Never fails the bench."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--fleet-heal"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True, text=True,
+            timeout=int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300")))
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            if line.strip().startswith("{"):
+                return json.loads(line)
+        return {"fleet_healed_ok": False, "fleet_heal_s": None,
+                "fleet_heal_error":
+                    f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:
+        return {"fleet_healed_ok": False, "fleet_heal_s": None,
+                "fleet_heal_error": repr(e)}
+
+
 def _measure_service_roundtrip():
     """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
     keys, or {service_error} — the bench line never fails on it."""
@@ -1031,6 +1147,9 @@ def main():
     if "--fleet-chaos" in sys.argv:
         fleet_chaos_main()
         return
+    if "--fleet-heal" in sys.argv:
+        fleet_heal_main()
+        return
     try:
         os.remove(_PARTIAL)
     except OSError:
@@ -1048,6 +1167,7 @@ def main():
         # measurement
         svc_box.update(_measure_service_roundtrip())
         svc_box.update(_measure_fleet_chaos())
+        svc_box.update(_measure_fleet_heal())
         svc_box.update(_measure_analysis_clean())
 
     svc_thread = threading.Thread(target=_side_measurements, daemon=True)
@@ -1056,7 +1176,7 @@ def main():
     def svc():
         svc_thread.join(
             timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300"))
-            + int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
+            + 2 * int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
             + int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")) + 30)
         out = dict(svc_box)
         if not any(k.startswith("service") for k in out):
@@ -1065,6 +1185,10 @@ def main():
             out["fleet_chaos_proof_ok"] = False
             out["fleet_recoveries"] = 0
             out["fleet_chaos_error"] = "did not finish"
+        if "fleet_healed_ok" not in out:
+            out["fleet_healed_ok"] = False
+            out["fleet_heal_s"] = None
+            out["fleet_heal_error"] = "did not finish"
         if "analysis_clean" not in out:
             out["analysis_clean"] = False
             out["analysis_detail"] = "did not finish"

@@ -255,10 +255,57 @@ class Dispatcher:
             WorkerHandle(h, p, index=i, tracker=self.tracker,
                          metrics=self.metrics, faults=faults, tracer=tracer)
             for i, (h, p) in enumerate(config.workers)]
-        self.pool = futures.ThreadPoolExecutor(max_workers=len(self.workers))
+        # headroom past the initial width: dynamic membership can grow the
+        # fleet mid-life (an undersized executor only costs parallelism,
+        # never correctness, but joins should not serialize the fan-outs)
+        self.pool = futures.ThreadPoolExecutor(
+            max_workers=max(8, 2 * len(self.workers)))
         self._ranges = None
         self._bases = None
         self._adopted = {}  # base-range i -> worker j that adopted it
+        # ranges whose INIT_BASES push failed at the last provisioning:
+        # their nominal owner may hold a STALE same-id set from an
+        # earlier init_bases, so routing there would succeed with wrong
+        # bases — these ranges go straight to the adoption path instead
+        self._unprovisioned = set()
+        # dynamic membership (runtime/membership.py): enable_membership()
+        # arms it; a fleet without it behaves exactly as before (epoch 0
+        # frames, fixed width)
+        self.membership = None
+        self._member_server = None
+
+    @property
+    def epoch(self):
+        """Current membership-roster version (0 = static fleet)."""
+        return self.membership.epoch if self.membership is not None else 0
+
+    def enable_membership(self, host="127.0.0.1", port=0):
+        """Own a membership registry + serve it (JOIN/LEAVE/ROSTER) on
+        `host:port` (0 = ephemeral). Returns the MembershipServer (its
+        `.port` is what workers pass to --join)."""
+        from .membership import MembershipRegistry, MembershipServer
+        if self.membership is None:
+            self.membership = MembershipRegistry(
+                self, metrics=self.metrics, tracer=self.tracer)
+        if self._member_server is None:
+            self._member_server = MembershipServer(
+                self.membership, host=host, port=port)
+        return self._member_server
+
+    def adopt_worker(self, host, port):
+        """Append one worker to the fleet (membership JOIN path); returns
+        its index. Indices are stable forever — the sharded FFT's
+        col_ranges and the MSM range table keep indexing by fleet
+        position. The new worker is schedulable immediately: the next
+        fft_dist attempt plans over the wider usable set, and the next
+        init_bases() range-shards across the full width; until then it
+        serves NTTs and adopts dead MSM ranges like any survivor."""
+        i = self.tracker.add_worker()
+        self.workers.append(
+            WorkerHandle(host, port, index=i, tracker=self.tracker,
+                         metrics=self.metrics, faults=self.faults,
+                         tracer=self.tracer))
+        return i
 
     def ping(self):
         for w in self.workers:
@@ -278,6 +325,8 @@ class Dispatcher:
         get the breaker opened immediately (authoritative evidence)."""
         def one(iw):
             i, w = iw
+            if self._left(i):
+                return  # decommissioned: stays dead regardless of probes
             if w.probe() is None:
                 self.tracker.mark_dead(i)
                 w.drop_conn()
@@ -285,12 +334,21 @@ class Dispatcher:
                 self.tracker.record_ok(i)
         list(self.pool.map(one, enumerate(self.workers)))
 
+    def _left(self, i):
+        """True for a member declared permanently gone via LEAVE: the
+        re-admission planes must not probe or revive it (a
+        decommissioned address may still answer) — only an explicit
+        JOIN brings it back."""
+        return self.membership is not None and self.membership.is_left(i)
+
     def _maybe_readmit(self):
         """Half-open probes for breaker-open workers whose backoff window
         elapsed; a worker that answers is re-admitted and (if bases are
         provisioned) gets its original MSM range re-uploaded so routing
         rebalances instead of leaning on the adopter forever."""
         for i in self.tracker.due_probes():
+            if self._left(i):
+                continue
             w = self.workers[i]
             if w.probe() is None:
                 self.tracker.record_failure(i)
@@ -313,6 +371,7 @@ class Dispatcher:
                 protocol.INIT_BASES,
                 protocol.encode_init_bases(i, self._bases[start:end]))
             self._adopted.pop(i, None)
+            self._unprovisioned.discard(i)
         except Exception:
             pass
 
@@ -331,9 +390,19 @@ class Dispatcher:
         self._adopted = {}
         # a worker that is dead at provisioning time is tolerated: its
         # range stays unowned and the first msm() adopts it onto a healthy
-        # worker through the same lazy-recovery path as a mid-prove death
+        # worker through the same lazy-recovery path as a mid-prove death.
+        # The map MUST be materialized with list(): Executor.map's result
+        # generator CANCELS still-pending futures when it is closed
+        # early, so a short-circuiting consumer (the old `all(...)`)
+        # could silently skip a worker's INIT_BASES under load — leaving
+        # a STALE same-id base set from an earlier provisioning on an
+        # alive worker, which then serves later MSMs with wrong bases
+        # (caught live as an intermittent wrong-proof in the fleet-TCP
+        # tests). Failed pushes are remembered in _unprovisioned so
+        # msm() routes those ranges through recovery instead of trusting
+        # the nominal owner.
         with self._span("fleet/init_bases", n=n) as prov_sid:
-            results = self.pool.map(
+            results = list(self.pool.map(
                 lambda iw: _try(
                     lambda iw: iw[1].call(protocol.INIT_BASES,
                                           protocol.encode_init_bases(
@@ -342,8 +411,10 @@ class Dispatcher:
                                                     self._ranges[iw[0]][1]]),
                                           parent=prov_sid),
                     iw),
-                enumerate(self.workers))
-            if all(isinstance(r, _Failure) for r in results):
+                enumerate(self.workers)))
+            self._unprovisioned = {
+                i for i, r in enumerate(results) if isinstance(r, _Failure)}
+            if results and len(self._unprovisioned) == len(results):
                 raise RuntimeError("no worker accepted its base range")
 
     def msm(self, scalars):
@@ -367,6 +438,13 @@ class Dispatcher:
             chunk = scalars[start:end]
             if not chunk:
                 return None
+            # a range whose provisioning push failed must NOT be served
+            # by its nominal owner: an alive worker can hold a stale
+            # same-id set from an earlier init_bases and would answer
+            # with the wrong partial — force the adoption path, which
+            # re-pushes the bases before computing
+            if i in self._unprovisioned and i not in self._adopted:
+                raise ConnectionError(f"range {i} never provisioned")
             # an adopted range routes straight to its new owner — no
             # re-dialing the dead worker, no re-upload
             w = self.workers[self._adopted.get(i, i)]
@@ -377,8 +455,11 @@ class Dispatcher:
 
         total = None
         failed = []
+        # ranges, not workers: a member that joined after init_bases()
+        # holds no range yet (it becomes an adopter/full member at the
+        # next provisioning)
         for i, res in enumerate(self.pool.map(
-                lambda i: _try(part, i), range(len(self.workers)))):
+                lambda i: _try(part, i), range(len(self._ranges)))):
             if isinstance(res, _Failure):
                 failed.append(i)
             else:
@@ -407,6 +488,13 @@ class Dispatcher:
             return None
         k = len(self.workers)
         failed_owner = self._adopted.get(dead_i, dead_i)
+        # an UNPROVISIONED range's owner never actually failed a call —
+        # msm() pre-empted it because its bases may be stale. adopt()
+        # re-pushes fresh bases first, so the owner is a legitimate
+        # candidate (excluding it could fail a prove with a healthy
+        # worker available, e.g. k=2 with the other worker dead)
+        if dead_i in self._unprovisioned and dead_i not in self._adopted:
+            failed_owner = None
         last_err = None
 
         def adopt(j):
@@ -417,6 +505,7 @@ class Dispatcher:
                          protocol.encode_msm_request(dead_i, chunk),
                          parent=fleet_sid)
             self._adopted[dead_i] = j
+            self._unprovisioned.discard(dead_i)  # freshly pushed to j
             self.metrics.inc("fleet_range_adoptions")
             return protocol.decode_point(raw)
 
@@ -446,6 +535,8 @@ class Dispatcher:
         call, not fast-fail it (call() alone would raise
         WorkerUnavailable without dialing)."""
         for i in candidates:
+            if self._left(i):
+                continue  # decommissioned: only a JOIN revives it
             if self.workers[i].probe() is None:
                 continue  # actually dead: leave the breaker open
             self.tracker.record_ok(i)  # alive: re-admit, then route to it
@@ -539,6 +630,19 @@ class Dispatcher:
                     # attribute the loss: probe everyone, open breakers on
                     # the actually-dead, then replan on the survivors
                     self._probe_fleet()
+                    if self.membership is not None:
+                        # the failure may be roster lag, not death: a
+                        # worker that missed a push rejects plans whose
+                        # epoch mismatches its table. Re-push and WAIT
+                        # (bounded) so the next attempt — which re-reads
+                        # self.epoch — runs against a converged fleet;
+                        # the one same-set retry below then succeeds
+                        # instead of burning on the identical rejection.
+                        for f in self.membership.push_roster():
+                            try:
+                                f.result(timeout=5)
+                            except Exception:
+                                pass
                     if self.tracker.usable_set() == active:
                         # nobody actually died: a transient (dropped/
                         # corrupt frame, one slow call) gets ONE same-set
@@ -587,11 +691,18 @@ class Dispatcher:
                     f"fft phase lost {len(failures)} worker(s)") \
                     from failures[0].err
 
+        # the frame carries the membership epoch this plan was made
+        # against: a worker whose roster moved on (a join/leave landed
+        # mid-attempt) rejects it loudly and the outer loop replans at
+        # the CURRENT width — how the fleet replans *up* at the next
+        # phase boundary instead of finishing narrow
+        epoch = self.epoch
         run_phase(
             lambda i: self.workers[i].call(
                 protocol.FFT_INIT, protocol.encode_fft_init(
                     task_id, inverse, coset, n, r, c,
-                    row_bounds[i][0], row_bounds[i][1], col_ranges),
+                    row_bounds[i][0], row_bounds[i][1], col_ranges,
+                    epoch=epoch),
                 parent=fft_sid),
             active)
 
@@ -702,6 +813,8 @@ class Dispatcher:
         return [one(w) for w in self.workers]
 
     def shutdown(self):
+        if self._member_server is not None:
+            self._member_server.close()
         for w in self.workers:
             try:
                 w.call(protocol.SHUTDOWN)

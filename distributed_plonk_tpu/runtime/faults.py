@@ -9,6 +9,15 @@ One injector object threads through both failure planes:
       (loadgen chaos soak). Actions:
         kill     invoke the registered kill callback (test harness kills
                  the worker process; a real deploy could fence a pod)
+
+  proc plane (`at=proc`): rides the SAME on_send occurrence matching as
+      the wire plane, but `kill` invokes `proc_kill_cb` — registered by
+      the supervisor (runtime/supervisor.py) as a real SIGKILL of the
+      worker SUBPROCESS. Where the wire plane models "the frame/worker
+      vanished" at the dispatcher's edge, the proc plane kills an actual
+      OS process so the chaos harness exercises the supervisor's real
+      detect -> respawn -> rejoin recovery path:
+        DPT_FAULTS="kill:at=proc:tag=FFT1:worker=1"
         drop     raise InjectedDrop (a ConnectionError) without sending —
                  the frame "was lost"; the handle's reconnect/backoff path
                  must resend (worker handlers are idempotent)
@@ -153,9 +162,14 @@ class FaultInjector:
     rate-based decisions (seed it for reproducible soaks).
     """
 
-    def __init__(self, rules=None, kill_cb=None, metrics=None, rng=None):
+    def __init__(self, rules=None, kill_cb=None, metrics=None, rng=None,
+                 proc_kill_cb=None):
         self.rules = list(rules or [])
         self.kill_cb = kill_cb
+        # proc-plane kill: SIGKILL the worker SUBPROCESS (the supervisor
+        # registers its kill(); falls back to kill_cb when unset so a
+        # harness with one process-level callback serves both planes)
+        self.proc_kill_cb = proc_kill_cb
         self.metrics = metrics
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
@@ -195,7 +209,7 @@ class FaultInjector:
         May sleep (delay), raise InjectedDrop (drop), or kill the worker
         out from under the send (kill)."""
         for rule in self.rules:
-            if rule.plane != "wire":
+            if rule.plane not in ("wire", "proc"):
                 continue
             if not self._due(rule, tag=tag, worker=worker):
                 continue
@@ -208,8 +222,12 @@ class FaultInjector:
             elif rule.action == "corrupt":
                 tag = tag ^ _CORRUPT_TAG_XOR
             elif rule.action == "kill":
-                if self.kill_cb is not None:
-                    self.kill_cb(worker)
+                # proc-plane kill SIGKILLs the actual subprocess (the
+                # supervisor's recovery path gets exercised for real)
+                cb = (self.proc_kill_cb or self.kill_cb) \
+                    if rule.plane == "proc" else self.kill_cb
+                if cb is not None:
+                    cb(worker)
         return tag
 
     # -- checkpoint plane (prover pool) ---------------------------------------

@@ -67,6 +67,14 @@ class LivenessTracker:
         return {"open": False, "failures": 0, "next_probe": 0.0,
                 "probe_backoff": 0.0, "opens": 0}
 
+    def add_worker(self):
+        """Grow the table by one (dynamic membership: a JOIN appends a
+        worker; indices are stable, so growth is append-only). Returns
+        the new worker's index."""
+        with self._lock:
+            self._state.append(self._fresh())
+            return len(self._state) - 1
+
     def _jitter(self, base):
         """base + up to 50% random jitter: fleet-wide probes/retries must
         not synchronize into thundering herds."""

@@ -157,6 +157,18 @@ class Listener:
             self.fd = -1
 
 
+def listener_port(listener):
+    """Actual bound port of a Listener (needed when it bound port 0 for
+    an ephemeral port — the membership JOIN flow announces it)."""
+    import os
+    import socket
+    s = socket.socket(fileno=os.dup(listener.fd))
+    try:
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 def connect(host, port, timeout_ms=0):
     """timeout_ms bounds the CONNECT itself (0 = blocking); I/O timeouts
     are set separately via Conn.set_timeout after the dial succeeds."""

@@ -72,6 +72,30 @@ TRACE_DUMP = 27    # JSON {trace_id} -> OK + JSON tracer dump ({} when
                    # dispatcher can stitch them into the merged per-job
                    # timeline (trace.merge_traces, offset-corrected
                    # against the HEALTH clock sample)
+# --- dynamic membership plane (runtime/membership.py) ------------------------
+# Served by the dispatcher's MembershipServer (JOIN/LEAVE/ROSTER as
+# queries) and by workers (ROSTER as a push). Control plane: JSON payloads.
+JOIN = 28          # JSON {host, port, store?, phase?, stats?} -> OK + JSON
+                   # {index, epoch, workers: ["h:p"...], stores: ["h:p"...]}
+                   # — a starting worker announces itself and receives its
+                   # fleet index + the epoch-numbered roster. A known
+                   # (host, port) re-JOINs IN PLACE (same index: the
+                   # supervisor-respawn path, re-admitted through the PR 6
+                   # breaker machinery). phase="ready" is an idempotent
+                   # update carrying warm-rejoin stats — no epoch bump.
+LEAVE = 29         # JSON {index | host+port} -> OK + JSON {epoch}: declare
+                   # a member permanently gone (supervisor flap cap, an
+                   # operator decommission) — breaker opened, epoch bumped
+ROSTER = 30        # to the membership server, empty payload: -> OK + JSON
+                   # {epoch, workers, stores} (query);
+                   # to a worker, JSON {epoch, workers}: adopt the pushed
+                   # table iff epoch is newer -> OK + JSON {epoch} — how
+                   # FFT2_PREPARE peer routing follows membership changes
+STORE_LIST = 31    # JSON {prefix?} -> OK + JSON {keys}: enumerate store
+                   # keys (manifest artifacts plus jaxcache:<relpath>
+                   # pseudo-keys for persistent-compile-cache files) so a
+                   # joining worker knows what to STORE_FETCH for its warm
+                   # rejoin
 OK = 100
 ERR = 101
 
@@ -210,21 +234,30 @@ def decode_msm_request(raw):
     return set_id, decode_scalars(raw[16:16 + n * FR_BYTES])
 
 
-def encode_fft_init(task_id, inverse, coset, n, r, c, rs, re, col_ranges):
+def encode_fft_init(task_id, inverse, coset, n, r, c, rs, re, col_ranges,
+                    epoch=0):
     """col_ranges: every worker's stage-2 row range [(cs, ce)] — each worker
-    needs the full table to route its peer exchange."""
+    needs the full table to route its peer exchange. `epoch` is the
+    sender's membership-roster version (0 = no membership plane): a worker
+    whose roster moved past it rejects the frame as stale, forcing the
+    dispatcher to replan on the CURRENT fleet width."""
     flags = (1 if inverse else 0) | (2 if coset else 0)
     head = struct.pack("<QBQQQQQQ", task_id, flags, n, r, c, rs, re,
                        len(col_ranges))
-    return head + b"".join(struct.pack("<QQ", cs, ce) for cs, ce in col_ranges)
+    body = b"".join(struct.pack("<QQ", cs, ce) for cs, ce in col_ranges)
+    return head + body + struct.pack("<Q", epoch)
 
 
 def decode_fft_init(raw):
     task_id, flags, n, r, c, rs, re, k = struct.unpack_from("<QBQQQQQQ", raw, 0)
     off = struct.calcsize("<QBQQQQQQ")
     col_ranges = [struct.unpack_from("<QQ", raw, off + 16 * i) for i in range(k)]
+    off += 16 * k
+    # trailing epoch is optional on the wire: frames from pre-membership
+    # senders decode as epoch 0 (accepted everywhere)
+    epoch = struct.unpack_from("<Q", raw, off)[0] if len(raw) >= off + 8 else 0
     return (task_id, bool(flags & 1), bool(flags & 2), n, r, c, rs, re,
-            col_ranges)
+            col_ranges, epoch)
 
 
 def encode_fft1_matrix(task_id, first_row, panel):

@@ -1,0 +1,336 @@
+"""Worker supervision: spawn, liveness-watch, respawn with backoff.
+
+The process-level half of the self-healing fleet (runtime/membership.py
+is the fleet-level half): a WorkerSupervisor owns N local worker
+SUBPROCESSES started with `--join`, watches each one's liveness through
+the existing HEALTH probe with a consecutive-miss budget, and respawns
+dead or wedged ones with jittered exponential backoff. A respawned
+worker rejoins through the exact same JOIN path as a brand-new one —
+same port, same fleet index, re-admitted via the PR 6 breaker machinery
+and warm-rejoined from the roster's store peers; the supervisor has no
+special re-entry protocol.
+
+A crash-looping worker (bad binary, poisoned store) must not be
+respawned forever: `flap_cap` respawns inside `flap_window_s` marks the
+slot FAILED, stops respawning it, and (when the membership address is
+known) declares it gone with a LEAVE so the fleet stops probing the
+corpse. Counters land in the duck-typed metrics registry:
+worker_respawns / worker_flap_capped / supervisor_probe_misses, gauge
+supervised_workers.
+
+Startup is graced: the miss budget only ticks once a worker has answered
+its FIRST probe — before that, only `startup_grace_s` elapsing counts as
+wedged. A freshly spawned interpreter on a loaded host can take tens of
+seconds to import and bind; probing it at the steady-state cadence would
+wedge-kill healthy starting workers in a loop straight into the flap cap
+(found live under tier-1 load).
+
+Knobs (env, read at construction; constructor args override):
+    DPT_SUP_PROBE_MS        liveness probe interval (500)
+    DPT_SUP_PROBE_TIMEOUT_MS  per-probe budget (3000)
+    DPT_SUP_MISS_BUDGET     consecutive misses before a respawn (3)
+    DPT_SUP_STARTUP_GRACE_S first-answer deadline for a fresh spawn (120)
+    DPT_SUP_BACKOFF_BASE_MS first respawn delay (250)
+    DPT_SUP_BACKOFF_MAX_MS  respawn delay ceiling (10000)
+    DPT_SUP_FLAP_CAP        respawns inside the window before giving up (5)
+    DPT_SUP_FLAP_WINDOW_S   the flap-counting window (60)
+"""
+
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from . import membership
+from .dispatcher import WorkerHandle
+from .health import NullMetrics
+
+
+def _env_ms(name, default):
+    # analysis: ok(host-only ms->s conversion, no traced arithmetic)
+    return float(os.environ.get(name, default)) / 1000.0
+
+
+def reserve_port(host="127.0.0.1"):
+    """Pick a currently-free port for a worker slot. The tiny bind race
+    (another process grabbing it before the worker does) is tolerated on
+    the loopback deployments this targets: the worker's bind then fails,
+    the supervisor sees the death and respawns on a fresh port."""
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class _Slot:
+    """One supervised worker: its reserved address, live subprocess, and
+    flap bookkeeping. Mutated only under the supervisor's lock."""
+
+    def __init__(self, port, store_dir=None):
+        self.port = port
+        self.store_dir = store_dir
+        self.proc = None
+        self.misses = 0
+        self.backoff = 0.0
+        self.next_spawn = 0.0
+        self.spawn_times = []  # monotonic stamps inside the flap window
+        self.spawned_at = 0.0
+        self.answered = False  # this incarnation answered >= 1 probe
+        self.healthy_since = None
+        self.failed = False
+        self.respawns = 0
+
+
+class WorkerSupervisor:
+    def __init__(self, join_host, join_port, n=0, backend="python",
+                 host="127.0.0.1", store_dirs=None, metrics=None,
+                 probe_interval_s=None, probe_timeout_ms=None,
+                 miss_budget=None, startup_grace_s=None,
+                 backoff_base_s=None, backoff_max_s=None,
+                 flap_cap=None, flap_window_s=None, cwd=None, rng=None,
+                 spawn_cmd=None, extra_args=None):
+        """spawn_cmd(slot_index, slot) -> argv overrides the worker
+        command line (tests inject crash-looping commands); store_dirs:
+        per-slot artifact-store dirs (workers then serve STORE_FETCH and
+        warm-rejoin on respawn)."""
+        self.join_host, self.join_port = join_host, join_port
+        self.backend = backend
+        self.host = host
+        self.metrics = metrics or NullMetrics()
+        self.cwd = cwd
+        self.spawn_cmd = spawn_cmd
+        self.extra_args = list(extra_args or [])
+        self.probe_interval_s = probe_interval_s if probe_interval_s \
+            is not None else _env_ms("DPT_SUP_PROBE_MS", "500")
+        self.probe_timeout_ms = probe_timeout_ms if probe_timeout_ms \
+            is not None else int(os.environ.get("DPT_SUP_PROBE_TIMEOUT_MS",
+                                                "3000"))
+        self.miss_budget = miss_budget if miss_budget is not None else \
+            int(os.environ.get("DPT_SUP_MISS_BUDGET", "3"))
+        self.startup_grace_s = startup_grace_s if startup_grace_s \
+            is not None else float(os.environ.get("DPT_SUP_STARTUP_GRACE_S",
+                                                  "120"))
+        self.backoff_base_s = backoff_base_s if backoff_base_s is not None \
+            else _env_ms("DPT_SUP_BACKOFF_BASE_MS", "250")
+        self.backoff_max_s = backoff_max_s if backoff_max_s is not None \
+            else _env_ms("DPT_SUP_BACKOFF_MAX_MS", "10000")
+        self.flap_cap = flap_cap if flap_cap is not None else \
+            int(os.environ.get("DPT_SUP_FLAP_CAP", "5"))
+        self.flap_window_s = flap_window_s if flap_window_s is not None \
+            else float(os.environ.get("DPT_SUP_FLAP_WINDOW_S", "60"))
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher = None
+        store_dirs = list(store_dirs or [])
+        self.slots = [
+            _Slot(reserve_port(host),
+                  store_dirs[i] if i < len(store_dirs) else None)
+            for i in range(n)]
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self):
+        for i in range(len(self.slots)):
+            self._spawn(i)
+        self._watcher = threading.Thread(target=self._watch_loop,
+                                         name="worker-supervisor",
+                                         daemon=True)
+        self._watcher.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=10)
+        with self._lock:
+            procs = [s.proc for s in self.slots if s.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+    def add_slot(self, store_dir=None):
+        """Grow the supervised fleet by one slot at runtime (scale-up):
+        the new worker takes the exact JOIN path of every other member.
+        Returns the slot index; the worker is spawned immediately."""
+        with self._lock:
+            self.slots.append(_Slot(reserve_port(self.host), store_dir))
+            i = len(self.slots) - 1
+        self._spawn(i)
+        return i
+
+    # -- chaos / introspection -------------------------------------------------
+
+    def slot_for_port(self, port):
+        with self._lock:
+            for j, s in enumerate(self.slots):
+                if s.port == port:
+                    return j
+        return None
+
+    def proc_killer(self, dispatcher):
+        """kill_cb for the `kill:at=proc` chaos plane: the injector hands
+        over a DISPATCHER worker index, which need not equal the slot
+        index (join order is concurrent) — translate through the
+        address, which is the stable identity on both sides."""
+        def _kill(i):
+            j = self.slot_for_port(dispatcher.workers[i].port)
+            if j is not None:
+                self.kill(j)
+        return _kill
+
+    def kill(self, i, sig=signal.SIGKILL):
+        """SIGKILL slot i's subprocess — the `kill:at=proc` chaos plane's
+        callback (runtime/faults.py) and the heal canary's trigger. The
+        watch loop then detects the death and respawns through the
+        normal path."""
+        with self._lock:
+            proc = self.slots[i].proc
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(sig)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+
+    def address(self, i):
+        return self.host, self.slots[i].port
+
+    def snapshot(self):
+        with self._lock:
+            return [{"port": s.port, "respawns": s.respawns,
+                     "failed": s.failed,
+                     "alive": s.proc is not None and s.proc.poll() is None}
+                    for s in self.slots]
+
+    # -- internals ------------------------------------------------------------
+
+    def _cmd(self, i, slot):
+        if self.spawn_cmd is not None:
+            return self.spawn_cmd(i, slot)
+        cmd = [sys.executable, "-m", "distributed_plonk_tpu.runtime.worker",
+               "--join", f"{self.join_host}:{self.join_port}",
+               "--listen", f"{self.host}:{slot.port}",
+               "--backend", self.backend]
+        if slot.store_dir is not None:
+            cmd += ["--store", slot.store_dir]
+        return cmd + self.extra_args
+
+    def _spawn(self, i):
+        """Start slot i's subprocess (caller ensured backoff elapsed)."""
+        with self._lock:
+            slot = self.slots[i]
+            if slot.failed or self._stop.is_set():
+                return
+            now = time.monotonic()
+            slot.spawn_times = [t for t in slot.spawn_times
+                                if now - t <= self.flap_window_s]
+            slot.spawn_times.append(now)
+            slot.misses = 0
+            slot.healthy_since = None
+            slot.spawned_at = now
+            slot.answered = False
+            first = slot.proc is None
+            slot.proc = subprocess.Popen(self._cmd(i, slot), cwd=self.cwd)
+        if not first:
+            self.metrics.inc("worker_respawns")
+            with self._lock:
+                slot.respawns += 1
+        self.metrics.gauge("supervised_workers", len(self.slots))
+
+    def _schedule_respawn(self, i):
+        """Slot i's process is dead/wedged: arm the next spawn time with
+        jittered exponential backoff, or give up at the flap cap (stop
+        respawning, declare the member gone via LEAVE)."""
+        now = time.monotonic()
+        gave_up = False
+        with self._lock:
+            slot = self.slots[i]
+            if slot.failed:
+                return
+            recent = [t for t in slot.spawn_times
+                      if now - t <= self.flap_window_s]
+            if len(recent) >= self.flap_cap:
+                slot.failed = True
+                gave_up = True
+            else:
+                slot.backoff = min(self.backoff_max_s,
+                                   (slot.backoff * 2) or self.backoff_base_s)
+                jitter = 1.0 + 0.5 * self._rng.random()  # analysis: ok(host-only jitter)
+                slot.next_spawn = now + slot.backoff * jitter
+                slot.misses = 0
+        if gave_up:
+            # network call outside the lock: a slow membership server
+            # must not stall supervision of the other slots
+            self.metrics.inc("worker_flap_capped")
+            membership.leave_fleet(self.join_host, self.join_port,
+                                   self.host, slot.port)
+
+    def _watch_one(self, i):
+        now = time.monotonic()
+        with self._lock:
+            slot = self.slots[i]
+            if slot.failed:
+                return
+            proc, next_spawn = slot.proc, slot.next_spawn
+        if proc is None or proc.poll() is not None:
+            # process is gone: respawn once the backoff window passes
+            if next_spawn == 0.0:
+                self._schedule_respawn(i)
+            elif now >= next_spawn:
+                with self._lock:
+                    slot.next_spawn = 0.0
+                self._spawn(i)
+            return
+        # process alive: probe HEALTH (a wedged worker answers nothing)
+        h, p = self.address(i)
+        snap = WorkerHandle(h, p).probe(timeout_ms=self.probe_timeout_ms)
+        with self._lock:
+            if snap is None:
+                self.metrics.inc("supervisor_probe_misses")
+                if not slot.answered:
+                    # STARTUP GRACE: a fresh interpreter on a loaded
+                    # host takes tens of seconds to import and bind —
+                    # the steady-state miss budget would wedge-kill
+                    # healthy starting workers in a loop straight into
+                    # the flap cap. Before the first answer, only the
+                    # grace deadline counts as wedged.
+                    wedged = (now - slot.spawned_at
+                              >= self.startup_grace_s)
+                else:
+                    slot.misses += 1
+                    slot.healthy_since = None
+                    wedged = slot.misses >= self.miss_budget
+            else:
+                slot.answered = True
+                slot.misses = 0
+                if slot.healthy_since is None:
+                    slot.healthy_since = now
+                elif now - slot.healthy_since >= self.flap_window_s:
+                    slot.backoff = 0.0  # stable again: forgive the past
+                wedged = False
+        if wedged:
+            self.kill(i)
+            self._schedule_respawn(i)
+
+    def _watch_loop(self):
+        while not self._stop.wait(self.probe_interval_s):
+            for i in range(len(self.slots)):
+                if self._stop.is_set():
+                    return
+                try:
+                    self._watch_one(i)
+                except Exception:  # supervision must outlive any one slot
+                    pass

@@ -91,11 +91,16 @@ class FftTask:
 
 
 class WorkerState:
-    def __init__(self, backend, config=None, me=0, store=None):
+    def __init__(self, backend, config=None, me=0, store=None, epoch=0):
         self.backend = backend
         self.config = config
         self.me = me
         self.store = store  # optional ArtifactStore served via STORE_FETCH
+        # membership-roster version this worker last adopted (0 = static
+        # fleet / never joined): FFT_INIT frames planned against an older
+        # epoch are rejected as stale, and ROSTER pushes advance it
+        self.epoch = epoch
+        self.warm = None  # warm-rejoin stats (store/remote.warm_sync)
         self.started = time.monotonic()
         self.base_sets = {}  # set_id -> bases (a worker can adopt ranges)
         self.lock = threading.Lock()
@@ -344,9 +349,24 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                   protocol.encode_scalar_matrix(protocol.ints_to_matrix(out)))
     elif tag == protocol.FFT_INIT:
         (task_id, inverse, coset, n, r, c, rs, re,
-         col_ranges) = protocol.decode_fft_init(payload)
+         col_ranges, epoch) = protocol.decode_fft_init(payload)
         now = time.monotonic()
         with state.lock:
+            if epoch and state.epoch and epoch != state.epoch:
+                # roster mismatch in EITHER direction is unservable: an
+                # older plan's col_ranges no longer match the fleet, and
+                # a NEWER plan references peers this worker's table does
+                # not know yet (it missed a roster push) — rejecting
+                # loudly beats an IndexError mid-exchange, and the
+                # dispatcher re-pushes the roster on the replan path so
+                # the lagging side converges (epoch 0 on either side =
+                # no membership plane, always accepted)
+                state.counters["stale_epoch"] = \
+                    state.counters.get("stale_epoch", 0) + 1
+                conn.send(protocol.ERR,
+                          b"stale epoch: frame %d, roster %d"
+                          % (epoch, state.epoch))
+                return None
             _evict_fft_tasks(state.fft_tasks, _FFT_TASK_CAP, now)
             state.fft_tasks[task_id] = FftTask(
                 inverse, coset, n, r, c, rs, re, col_ranges, state.me)
@@ -489,8 +509,40 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                 # trace timestamps get onto one timeline
                 "now": time.time(),
                 "traces": len(state.traces),
+                "epoch": state.epoch,
+                # warm-rejoin stats (set once after a --join worker
+                # finishes its peer sync): the supervisor/operator's
+                # evidence that a respawn came up warm
+                "warm": state.warm,
             }
         conn.send(protocol.OK, _json.dumps(snap).encode())
+    elif tag == protocol.ROSTER:
+        # membership push: adopt the epoch table iff it is NEWER (an
+        # out-of-order push is a no-op — epochs only move forward), and
+        # drop every cached peer stream: indices are stable but a rejoin
+        # means the old socket to that index is dead
+        import json as _json
+        req = protocol.decode_json(payload)
+        new_epoch = int(req.get("epoch", 0))
+        adopted = False
+        with state.lock:
+            if new_epoch > state.epoch:
+                state.epoch = new_epoch
+                state.config = NetworkConfig(req.get("workers", []))
+                adopted = True
+        if adopted:
+            with state.peer_lock:
+                stale = list(state.peers)
+            for p in stale:
+                state.drop_peer(p)
+        conn.send(protocol.OK,
+                  _json.dumps({"epoch": state.epoch,
+                               "adopted": adopted}).encode())
+    elif tag == protocol.STORE_LIST:
+        from ..store import remote as store_remote
+        store_remote.serve_list(
+            state.store, payload, conn,
+            no_store_reason="no store on this worker (--store)")
     elif tag == protocol.TRACE_DUMP:
         # fetch-and-forget one trace's worker-side spans: the dispatcher
         # stitches them (offset-corrected) into the merged per-job
@@ -516,16 +568,19 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
     return None
 
 
-def serve(index, config, backend_name="python", ready_event=None,
-          store_dir=None):
-    host, port = config.workers[index]
-    listener = native.Listener(host, port)
-    store = None
-    if store_dir is not None:
-        from ..store import ArtifactStore
-        store = ArtifactStore(store_dir)
-    state = WorkerState(_make_backend(backend_name), config=config, me=index,
-                        store=store)
+def _make_store(store_dir):
+    if store_dir is None:
+        return None
+    from ..store import ArtifactStore, set_jax_cache_env
+    # synced/persisted compiled executables live under the store: point
+    # a not-yet-imported jax backend's persistent compile cache there so
+    # warm-rejoined cache entries actually get hit
+    set_jax_cache_env(store_dir)
+    return ArtifactStore(store_dir)
+
+
+def _run_server(listener, state, ready_event=None):
+    """Accept loop until a SHUTDOWN frame lands."""
     if ready_event is not None:
         ready_event.set()
     stop = threading.Event()
@@ -547,15 +602,86 @@ def serve(index, config, backend_name="python", ready_event=None,
     listener.close()
 
 
+def serve(index, config, backend_name="python", ready_event=None,
+          store_dir=None):
+    """Static-fleet daemon: index + config fixed at startup (epoch 0)."""
+    host, port = config.workers[index]
+    listener = native.Listener(host, port)
+    # store BEFORE backend: _make_store points the jax compile cache
+    # under the store via env that field_jax reads at import — building
+    # the backend first would configure the cache elsewhere and leave
+    # this worker with zero jaxcache:* entries to serve warm-rejoiners
+    store = _make_store(store_dir)
+    state = WorkerState(_make_backend(backend_name), config=config, me=index,
+                        store=store)
+    _run_server(listener, state, ready_event=ready_event)
+
+
+def serve_joined(join_addr, listen_addr=("127.0.0.1", 0),
+                 backend_name="python", store_dir=None, ready_event=None):
+    """Dynamic-membership daemon (`--join host:port`): bind first (port 0
+    = ephemeral), announce to the membership server, adopt the returned
+    index + epoch + roster, serve — then warm-rejoin in the background:
+    pull bucket-key artifacts and jax persistent-compile-cache entries
+    from the roster's store-serving peers (STORE_FETCH/STORE_LIST), so a
+    replacement worker reaches first-kernel-launch without rebuilding
+    keys or recompiling stages. The worker is schedulable from the JOIN
+    ack; the sync only ACCELERATES first touches, it gates nothing."""
+    from . import membership
+    host, port = listen_addr
+    listener = native.Listener(host, port)
+    port = port or native.listener_port(listener)
+    reply = membership.join_fleet(join_addr[0], join_addr[1], host, port,
+                                  store=store_dir is not None)
+    store = _make_store(store_dir)
+    state = WorkerState(_make_backend(backend_name),
+                        config=NetworkConfig(reply["workers"]),
+                        me=int(reply["index"]), store=store,
+                        epoch=int(reply["epoch"]))
+
+    def warm_sync():
+        from ..store import remote as store_remote
+        me = f"{host}:{port}"
+        peers = [tuple(a.rsplit(":", 1)) for a in reply.get("stores", [])
+                 if a != me]
+        stats = {"warm_rejoin_s": 0.0, "artifacts": 0, "jax_cache_files": 0,
+                 "peers": 0}
+        if store is not None and peers:
+            stats = store_remote.warm_sync(
+                store, [(h, int(p)) for h, p in peers])
+        state.warm = stats
+        if store is not None:
+            # storeless joiners have nothing to sync: reporting ready
+            # would count a zero-length "warm rejoin" and fill the
+            # warm_rejoin_s histogram with meaningless 0.0 samples
+            membership.report_ready(join_addr[0], join_addr[1], host,
+                                    port, stats)
+
+    threading.Thread(target=warm_sync, daemon=True).start()
+    _run_server(listener, state, ready_event=ready_event)
+
+
+def _parse_hostport(s):
+    h, _, p = s.rpartition(":")
+    return h or "127.0.0.1", int(p)
+
+
 def main(argv):
-    index = int(argv[0])
-    cfg_path = argv[1] if len(argv) > 1 else "config/network.json"
     backend = "python"
     if "--backend" in argv:
         backend = argv[argv.index("--backend") + 1]
     store_dir = None
     if "--store" in argv:
         store_dir = argv[argv.index("--store") + 1]
+    if "--join" in argv:
+        join_addr = _parse_hostport(argv[argv.index("--join") + 1])
+        listen_addr = ("127.0.0.1", 0)
+        if "--listen" in argv:
+            listen_addr = _parse_hostport(argv[argv.index("--listen") + 1])
+        serve_joined(join_addr, listen_addr, backend, store_dir=store_dir)
+        return
+    index = int(argv[0])
+    cfg_path = argv[1] if len(argv) > 1 else "config/network.json"
     serve(index, NetworkConfig.load(cfg_path), backend, store_dir=store_dir)
 
 

@@ -99,6 +99,39 @@ story reads off one snapshot):
     faults_injected_* / faults_ckpt_corrupted  chaos-injection activity
                                              (runtime/faults.py)
 
+Membership & supervision vocabulary (runtime/membership.py,
+runtime/supervisor.py — the self-healing fleet):
+    fleet_size (gauge)                       current member count (slots,
+                                             incl. breaker-open ones)
+    membership_epoch (gauge)                 roster version; bumps on
+                                             every join/rejoin/leave
+    membership_joins / membership_rejoins    new members admitted vs
+                                             known addresses re-admitted
+                                             in place (supervisor
+                                             respawns land here)
+    membership_leaves                        members declared permanently
+                                             gone (flap cap, operator)
+    roster_pushes                            epoch tables pushed to live
+                                             workers after a change
+    warm_rejoins                             JOIN phase=ready reports
+                                             carrying warm-sync stats
+    warm_rejoin_s (histogram)                seconds a joiner spent
+                                             pulling bucket/compile-cache
+                                             artifacts from roster peers
+    worker_respawns                          supervisor restarts of dead
+                                             or wedged worker processes
+    worker_flap_capped                       slots given up on (flap_cap
+                                             respawns inside the window)
+    supervisor_probe_misses                  liveness probes a supervised
+                                             worker failed to answer
+    supervised_workers (gauge)               slots under supervision
+    bucket_peers_added / bucket_peers_removed  store-serving members
+                                             auto-registered as key-fetch
+                                             peers / dropped on LEAVE
+                                             (attach_membership)
+    store_list_served                        STORE_LIST enumerations
+                                             answered (warm-rejoin scans)
+
 Durability vocabulary (service/journal.py + the restart-recovery path):
     journal_appends / journal_replays        records written / replayed
                                              at open

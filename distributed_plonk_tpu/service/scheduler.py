@@ -101,6 +101,31 @@ class BucketCache:
         self._buckets = OrderedDict()
         self._latches = {}
 
+    def add_peer(self, host, port):
+        """Register one STORE_FETCH peer at runtime (idempotent) — the
+        membership plane's auto-discovery path: a worker that JOINs the
+        fleet advertising a store becomes a key-fetch tier immediately
+        (ProofService.attach_membership wires this up)."""
+        pair = (host, int(port))
+        with self._lock:
+            if pair in self.peers:
+                return False
+            self.peers.append(pair)
+        self.metrics.inc("bucket_peers_added")
+        return True
+
+    def remove_peer(self, host, port):
+        """Drop one STORE_FETCH peer (a member LEAVEd the fleet): every
+        later cold miss would otherwise burn PEER_TIMEOUT_MS dialing the
+        decommissioned address before falling through to a build."""
+        pair = (host, int(port))
+        with self._lock:
+            if pair not in self.peers:
+                return False
+            self.peers.remove(pair)
+        self.metrics.inc("bucket_peers_removed")
+        return True
+
     def get(self, spec):
         """Resources for the spec's shape, loading/building on first use."""
         return self.get_with_source(spec)[0]
@@ -192,7 +217,9 @@ class BucketCache:
         through — the build tier is always below us."""
         from ..store import remote as RS
         store_key = KC.bucket_store_key(key)
-        for host, port in self.peers:
+        with self._lock:
+            peers = list(self.peers)
+        for host, port in peers:
             blob = RS.fetch_into(self.store, host, port, store_key,
                                  timeout_ms=self.PEER_TIMEOUT_MS)
             if blob is None:

@@ -120,6 +120,25 @@ class ProofService:
         self._listener = None
         self._stopped = threading.Event()
 
+    def attach_membership(self, registry):
+        """Auto-discover the fleet's store-serving members as bucket-
+        cache peers (runtime/membership.py): every current store member
+        is registered now, and every future JOIN that advertises a store
+        is registered as it lands — a scaled-out service never needs a
+        hand-maintained --store-peers list (ROADMAP direction-2
+        remainder)."""
+        def _on_change(ev):
+            if ev.get("event") == "join" and ev.get("store"):
+                self.buckets.add_peer(ev["host"], ev["port"])
+            elif ev.get("event") == "leave" and "host" in ev:
+                # a decommissioned member must stop costing a peer-fetch
+                # timeout on every later cold miss
+                self.buckets.remove_peer(ev["host"], ev["port"])
+        registry.subscribe(_on_change)
+        for host, port in registry.store_peers():
+            self.buckets.add_peer(host, port)
+        return self
+
     # -- local (in-process) API ----------------------------------------------
 
     def submit_local(self, spec_obj):
@@ -512,6 +531,13 @@ class ProofService:
             # cross-host warm start and resume become a digest-verified
             # network copy (store/remote.py holds both wire sides)
             remote.serve_fetch(
+                self.store, payload, conn, metrics=self.metrics,
+                no_store_reason="no store on this server (serve --store-dir)")
+        elif tag == protocol.STORE_LIST:
+            # enumerate what STORE_FETCH can serve (manifest keys +
+            # jaxcache:<rel> compile-cache pseudo-keys): a joining
+            # worker's warm rejoin asks this first
+            remote.serve_list(
                 self.store, payload, conn, metrics=self.metrics,
                 no_store_reason="no store on this server (serve --store-dir)")
         elif tag == protocol.METRICS:

@@ -388,6 +388,54 @@ class ArtifactStore:
                 out.append((path, st.st_mtime, st.st_size))
         return out
 
+    def _jax_cache_path(self, rel):
+        """Absolute path for one cache-relative name, REFUSING anything
+        that escapes the cache root (peer-supplied names ride the wire —
+        a traversal like `../manifest.json` must be a loud error)."""
+        root = os.path.realpath(os.path.join(self.root, JAX_CACHE_SUBDIR))
+        rel = rel.replace("/", os.sep)
+        path = os.path.realpath(os.path.join(root, rel))
+        if os.path.isabs(rel) or path == root \
+                or not path.startswith(root + os.sep):
+            raise ValueError(f"jax-cache name escapes the cache: {rel!r}")
+        return path
+
+    def jax_cache_list(self):
+        """Cache-relative names (posix separators — the wire form used
+        by STORE_LIST's jaxcache:<rel> pseudo-keys) of every compile-
+        cache file, all machine-fingerprint partitions."""
+        root = os.path.join(self.root, JAX_CACHE_SUBDIR)
+        return sorted(
+            os.path.relpath(path, root).replace(os.sep, "/")
+            for path, _m, _s in self._jax_cache_files())
+
+    def jax_cache_has(self, rel):
+        try:
+            return os.path.exists(self._jax_cache_path(rel))
+        except ValueError:
+            return False
+
+    def jax_cache_read(self, rel):
+        """Bytes of one cache file, or None (missing / escaping name)."""
+        try:
+            with open(self._jax_cache_path(rel), "rb") as f:
+                return f.read()
+        except (ValueError, OSError):
+            return None
+
+    def jax_cache_write(self, rel, blob):
+        """Install one synced compile-cache file (warm rejoin): atomic
+        tmp+rename like artifact blobs — jax must never see a torn
+        entry. Budget enforcement stays with the normal sweeps."""
+        path = self._jax_cache_path(rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
     def jax_cache_bytes(self):
         """Fresh walk of the compile-cache tree (also refreshes the total
         that stats() reports without walking)."""

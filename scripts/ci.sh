@@ -19,7 +19,13 @@
 #                          proofs), breaker open/re-admission, cross-host
 #                          store-fetch resume, injection layer (~1-2 min,
 #                          jax-free: python backend worker subprocesses over
-#                          real TCP), the durable-service-plane suite
+#                          real TCP), PLUS the self-healing-fleet suite
+#                          (dynamic membership: join-mid-life FFT replan-up
+#                          byte-identity, stale-epoch rejection, supervisor
+#                          respawn + flap cap, warm rejoin w/ compile-cache
+#                          sync, bucket-peer auto-discovery, and the
+#                          kill->respawn->heal-to-full-width canary),
+#                          the durable-service-plane suite
 #                          (service killed at every journal transition ->
 #                          restart recovers byte-identically, dedup across
 #                          restart, torn journal, TTL shed, SIGTERM drain),
@@ -36,7 +42,8 @@ if [ "$1" = "analyze" ]; then
 fi
 if [ "$1" = "chaos" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_runtime_faults.py tests/test_service_journal.py \
+    tests/test_runtime_faults.py tests/test_membership.py \
+    tests/test_service_journal.py \
     tests/test_trace.py tests/test_obs.py tests/test_placement.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi

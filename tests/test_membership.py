@@ -1,0 +1,443 @@
+"""Self-healing fleet tests (ISSUE 12): dynamic membership, supervised
+respawn, warm rejoin.
+
+Acceptance surface: a 3-worker fleet with one member SIGKILLed mid-prove
+heals back to full width through supervisor respawn + JOIN re-admission
+with proof bytes IDENTICAL to the host oracle; a worker joining mid-life
+widens the sharded FFT at the next phase boundary; frames planned
+against an older roster are rejected as stale; a crash-looping slot hits
+the flap cap instead of being respawned forever; and a joiner with a
+store warm-rejoins (bucket keys + jax compile-cache entries pulled from
+roster peers, zero key builds) and is auto-discovered as a bucket-cache
+peer by an attached proof service.
+
+Wait discipline: every wait is event-driven against a generous deadline
+(these run inside ci.sh chaos and tier-1 under load), never a fixed
+sleep.
+"""
+
+import os
+import random
+import time
+
+import pytest
+
+from distributed_plonk_tpu import curve as C
+from distributed_plonk_tpu import poly as P
+from distributed_plonk_tpu.constants import R_MOD
+from distributed_plonk_tpu.runtime import protocol
+from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                      RemoteBackend,
+                                                      WorkerHandle)
+from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
+from distributed_plonk_tpu.runtime.health import LivenessTracker
+from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+from distributed_plonk_tpu.runtime.supervisor import WorkerSupervisor
+from distributed_plonk_tpu.service.metrics import Metrics
+
+RNG = random.Random(0x5E1F)
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+_LOAD_BUDGET_S = float(os.environ.get("DPT_TEST_WAIT_S", "120"))
+
+
+@pytest.fixture(autouse=True)
+def _fast_failure_knobs(monkeypatch):
+    monkeypatch.setattr(WorkerHandle, "RECONNECT_TRIES", 2)
+    monkeypatch.setattr(WorkerHandle, "BACKOFF_BASE_S", 0.01)
+    monkeypatch.setattr(WorkerHandle, "BACKOFF_MAX_S", 0.05)
+    monkeypatch.setattr(WorkerHandle, "TIMEOUT_MS", 120000)
+
+
+def _wait_for(cond, timeout_s=None, interval=0.05, msg=""):
+    deadline = time.monotonic() + (timeout_s or _LOAD_BUDGET_S)
+    while True:
+        got = cond()
+        if got:
+            return got
+        if time.monotonic() >= deadline:
+            raise AssertionError(f"timed out waiting for {msg or cond}")
+        time.sleep(interval)
+
+
+def _member_dispatcher(metrics=None, faults=None, breaker_k=2):
+    """Empty dispatcher + fast tracker + membership plane armed (the
+    tracker is swapped BEFORE any join, so appended workers line up)."""
+    metrics = metrics or Metrics()
+    d = Dispatcher(NetworkConfig([]), metrics=metrics, faults=faults)
+    d.tracker = LivenessTracker(0, breaker_k=breaker_k, probe_base_s=0.05,
+                                probe_max_s=0.5, metrics=metrics)
+    mserver = d.enable_membership()
+    return d, mserver, metrics
+
+
+def _wait_width(d, n, usable=True):
+    _wait_for(lambda: len(d.workers) >= n
+              and (not usable or len(d.tracker.usable_set()) >= n),
+              msg=f"fleet width {n}")
+
+
+def _shutdown(d, sup=None):
+    if sup is not None:
+        sup.stop()
+    try:
+        d.shutdown()
+    finally:
+        d.pool.shutdown(wait=False)
+
+
+def _supervised(n, metrics=None, faults=None, store_dirs=None, **sup_kw):
+    d, mserver, metrics = _member_dispatcher(metrics=metrics, faults=faults)
+    sup = WorkerSupervisor("127.0.0.1", mserver.port, n=n, backend="python",
+                           store_dirs=store_dirs, metrics=metrics, cwd=REPO,
+                           **sup_kw).start()
+    if faults is not None:
+        faults.proc_kill_cb = sup.proc_killer(d)
+    _wait_width(d, n)
+    return d, sup, metrics
+
+
+# --- membership basics --------------------------------------------------------
+
+def test_join_mid_life_replans_fft_up_byte_identity(proven):
+    """A worker joining a live 2-wide fleet widens the next sharded FFT
+    to 3 (the joiner serves stage work), and a full distributed prove on
+    the widened fleet is byte-identical to the host oracle."""
+    from distributed_plonk_tpu.prover import prove
+
+    ckt, pk, vk, proof_host = proven
+    d, sup, metrics = _supervised(2)
+    try:
+        n = 64
+        values = [RNG.randrange(R_MOD) for _ in range(n)]
+        want = P.ifft(P.Domain(n), values)
+        assert d.fft_dist(values, inverse=True) == want
+        epoch_before = d.epoch
+
+        # grow the supervisor by one slot at runtime: same JOIN path a
+        # brand-new host would take
+        assert sup.add_slot() == 2
+        _wait_width(d, 3)
+        assert d.epoch > epoch_before
+
+        # next phase boundary plans over the wider fleet: the joiner
+        # serves sharded-FFT frames (served-request counters say so)
+        assert d.fft_dist(values, inverse=True) == want
+        stats = _wait_for(
+            lambda: d.workers[2].probe(timeout_ms=5000), interval=0.2,
+            msg="joiner probe")
+        assert stats["epoch"] >= d.epoch - 1
+        served = d.stats()[2]
+        assert served.get(str(protocol.FFT_INIT), 0) >= 1
+        assert served.get(str(protocol.FFT2), 0) >= 1
+
+        # and the whole prove (FFTs + range-sharded MSM across all 3)
+        # is byte-identical to the host oracle
+        proof = prove(random.Random(1), ckt, pk,
+                      RemoteBackend(d, dist_fft_min=ckt.n))
+        assert proof.opening_proof == proof_host.opening_proof
+        assert proof.wires_poly_comms == proof_host.wires_poly_comms
+        assert proof.split_quot_poly_comms \
+            == proof_host.split_quot_poly_comms
+    finally:
+        _shutdown(d, sup)
+
+
+def test_stale_epoch_frame_rejected():
+    """A worker whose roster moved on rejects FFT_INIT frames planned
+    against an older epoch (loudly — ERR, not silent misrouting); epoch
+    0 (membership-less sender) and the current epoch stay accepted."""
+    d, sup, metrics = _supervised(1)
+    try:
+        w = d.workers[0]
+        cur = _wait_for(lambda: w.probe(timeout_ms=5000), interval=0.2,
+                        msg="probe")["epoch"]
+        assert cur >= 1
+
+        # push a newer roster directly: worker adopts it
+        newer = cur + 5
+        roster = protocol.encode_json(
+            {"epoch": newer, "workers": [f"{w.host}:{w.port}"]})
+        w.call(protocol.ROSTER, roster, traced=False)
+
+        def init(epoch):
+            return w.call(protocol.FFT_INIT, protocol.encode_fft_init(
+                RNG.getrandbits(63), False, False, 16, 4, 4, 0, 4,
+                [(0, 4)], epoch=epoch))
+
+        with pytest.raises(RuntimeError, match="stale epoch"):
+            init(newer - 1)
+        # a frame from AHEAD of this worker's roster is equally
+        # unservable (it references peers the worker's table lacks —
+        # the worker missed a push): loud rejection, not an IndexError
+        with pytest.raises(RuntimeError, match="stale epoch"):
+            init(newer + 3)
+        init(0)        # pre-membership sender: accepted
+        init(newer)    # current plan: accepted
+        # an OLDER roster push is ignored (epochs only move forward)
+        w.call(protocol.ROSTER, protocol.encode_json(
+            {"epoch": 1, "workers": []}), traced=False)
+        assert w.probe(timeout_ms=5000)["epoch"] == newer
+    finally:
+        _shutdown(d, sup)
+
+
+# --- supervision --------------------------------------------------------------
+
+def test_supervisor_respawns_and_rejoins_in_place():
+    """SIGKILL a supervised worker: the supervisor respawns it, it
+    re-JOINs under the SAME fleet index (no special re-entry path), the
+    breaker re-admits it, and MSM routing rebalances back onto it."""
+    d, sup, metrics = _supervised(2)
+    try:
+        n = 32
+        bases = [C.g1_mul(C.G1_GEN, RNG.randrange(1, R_MOD))
+                 for _ in range(n)]
+        scalars = [RNG.randrange(R_MOD) for _ in range(n)]
+        want = C.g1_msm(bases, scalars)
+        d.init_bases(bases)
+        assert d.msm(scalars) == want
+
+        width_before = len(d.workers)
+        sup.kill(1)
+        # supervisor detects the death and respawns; the rejoin lands on
+        # the same index — fleet table does NOT grow
+        _wait_for(lambda: metrics.snapshot()["counters"].get(
+            "membership_rejoins", 0) >= 1, msg="rejoin")
+        _wait_width(d, 2)
+        assert len(d.workers) == width_before
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("worker_respawns", 0) >= 1
+        # MSM correct regardless of when the rebalance lands; the
+        # re-provision eventually routes range 1 to worker 1 again
+        assert d.msm(scalars) == want
+        _wait_for(lambda: 1 not in d._adopted, msg="re-provision")
+        assert d.msm(scalars) == want
+    finally:
+        _shutdown(d, sup)
+
+
+def test_supervisor_flap_cap_gives_up_and_leaves():
+    """A crash-looping slot is respawned with backoff at most flap_cap
+    times inside the window, then marked FAILED and LEAVEd from the
+    fleet — never respawned forever."""
+    import sys
+    d, mserver, metrics = _member_dispatcher()
+    crash = [sys.executable, "-c", "raise SystemExit(1)"]
+    sup = WorkerSupervisor(
+        "127.0.0.1", mserver.port, n=1, metrics=metrics, cwd=REPO,
+        spawn_cmd=lambda i, slot: crash,
+        probe_interval_s=0.05, backoff_base_s=0.02, backoff_max_s=0.1,
+        flap_cap=3, flap_window_s=60).start()
+    try:
+        _wait_for(lambda: metrics.snapshot()["counters"].get(
+            "worker_flap_capped", 0) == 1, msg="flap cap")
+        assert sup.snapshot()[0]["failed"]
+        spawned = len(sup.slots[0].spawn_times)
+        assert spawned <= 3
+        # respawning has genuinely stopped
+        time.sleep(0.5)
+        assert len(sup.slots[0].spawn_times) == spawned
+        # the crash-looper never joined, so the fleet never saw it; a
+        # slot that HAD joined would be LEAVEd (membership_leaves) — the
+        # LEAVE here is a no-op lookup error, swallowed best-effort
+        assert len(d.workers) == 0
+    finally:
+        _shutdown(d, sup)
+
+
+def test_flap_cap_after_join_leaves_fleet():
+    """A member that joins, then keeps dying, is declared gone at the
+    flap cap: LEAVE bumps the epoch and opens its breaker so the fleet
+    stops routing to the corpse."""
+    d, sup, metrics = _supervised(
+        1, probe_interval_s=0.05, backoff_base_s=0.02, backoff_max_s=0.1,
+        flap_cap=2, flap_window_s=3600.0)
+    try:
+        epoch_before = d.epoch
+
+        def _flapped():
+            s = sup.snapshot()[0]
+            if s["failed"]:
+                return True
+            if s["alive"]:
+                sup.kill(0)  # keep the crash loop going until the cap
+            return False
+        _wait_for(_flapped, interval=0.2, msg="flap cap")
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("worker_flap_capped", 0) == 1
+        _wait_for(lambda: metrics.snapshot()["counters"].get(
+            "membership_leaves", 0) >= 1, msg="leave")
+        assert d.epoch > epoch_before
+        assert not d.tracker.usable(0)
+        # a LEAVEd member is never revived by the probe planes, even if
+        # its address still answers (start an unrelated listener there):
+        # only an explicit JOIN brings a decommissioned slot back
+        assert d.membership.is_left(0)
+        d.tracker.force_probe(0)
+        d._maybe_readmit()
+        assert not d.tracker.usable(0)
+        assert list(d._probe_readmit([0])) == []
+    finally:
+        _shutdown(d, sup)
+
+
+# --- warm rejoin + auto-discovery ---------------------------------------------
+
+def test_warm_rejoin_pulls_artifacts_and_compile_cache(tmp_path):
+    """A joiner with an empty store pulls bucket-key artifacts AND jax
+    persistent-compile-cache entries from the roster's store peers
+    (STORE_LIST + STORE_FETCH), reports warm_rejoin_s, and its HEALTH
+    shows the sync. Zero key builds anywhere."""
+    from distributed_plonk_tpu.service.jobs import (JobSpec,
+                                                    build_bucket_keys,
+                                                    shape_key)
+    from distributed_plonk_tpu.store import ArtifactStore
+    from distributed_plonk_tpu.store import keycache as KC
+
+    # warm peer store: real bucket keys + fake compile-cache entries
+    warm_dir = str(tmp_path / "warm")
+    warm = ArtifactStore(warm_dir)
+    spec = JobSpec.from_wire({"kind": "toy", "gates": 16, "seed": 5})
+    srs, pk, vk = build_bucket_keys(spec)
+    KC.store_bucket(warm, shape_key(spec), srs, pk, vk)
+    warm.jax_cache_write("fp/exec1.bin", b"compiled-exec-1")
+    warm.jax_cache_write("fp/exec2.bin", b"compiled-exec-2")
+
+    cold_dir = str(tmp_path / "cold")
+    # the warm peer joins FIRST (so its store is in the roster the cold
+    # joiner receives), then the cold worker scales in
+    d, sup, metrics = _supervised(1, store_dirs=[warm_dir])
+    try:
+        assert sup.add_slot(store_dir=cold_dir) == 1
+        _wait_width(d, 2)
+        # worker 1 (cold store) warm-rejoined from worker 0 (warm store)
+        snap = _wait_for(
+            lambda: (d.workers[1].probe(timeout_ms=5000) or {}).get("warm"),
+            interval=0.2, msg="warm rejoin stats")
+        assert snap["artifacts"] == 1
+        assert snap["jax_cache_files"] == 2
+        cold = ArtifactStore(cold_dir)
+        hit = KC.load_bucket(cold, shape_key(spec))
+        assert hit is not None and hit[2].domain_size == vk.domain_size
+        assert cold.jax_cache_read("fp/exec1.bin") == b"compiled-exec-1"
+        # the ready report landed the warm_rejoin_s observation
+        _wait_for(lambda: metrics.snapshot()["counters"].get(
+            "warm_rejoins", 0) >= 2, msg="ready reports")
+        assert "warm_rejoin_s" in metrics.snapshot()["histograms"]
+    finally:
+        _shutdown(d, sup)
+
+
+def test_join_store_auto_registered_as_bucket_peer(tmp_path, monkeypatch):
+    """ROADMAP direction-2 auto-discovery: a worker that JOINs with a
+    warm store becomes a BucketCache peer of an attached proof service —
+    the service then serves a seen shape with ZERO key builds (build
+    forbidden by monkeypatch), entirely from the joiner's store."""
+    import json
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+    from distributed_plonk_tpu.service import jobs as J
+    from distributed_plonk_tpu.store import ArtifactStore
+    from distributed_plonk_tpu.store import keycache as KC
+
+    warm_dir = str(tmp_path / "warm")
+    warm = ArtifactStore(warm_dir)
+    spec = {"kind": "toy", "gates": 16, "seed": 5}
+    sp = J.JobSpec.from_wire(spec)
+    srs, pk, vk = J.build_bucket_keys(sp)
+    KC.store_bucket(warm, J.shape_key(sp), srs, pk, vk)
+
+    d, mserver, metrics = _member_dispatcher()
+    svc = ProofService(port=0, prover_workers=1,
+                       store_dir=str(tmp_path / "svc")).start()
+    svc.attach_membership(d.membership)
+    sup = None
+    try:
+        assert svc.buckets.peers == []
+        sup = WorkerSupervisor("127.0.0.1", mserver.port, n=1,
+                               backend="python", store_dirs=[warm_dir],
+                               metrics=metrics, cwd=REPO).start()
+        _wait_width(d, 1)
+        _wait_for(lambda: len(svc.buckets.peers) == 1,
+                  msg="peer auto-registration")
+        assert svc.buckets.peers[0] == ("127.0.0.1", sup.slots[0].port)
+
+        def _forbidden(*a, **kw):
+            raise AssertionError("key build on the warm-peer path")
+        monkeypatch.setattr(J, "build_bucket_keys", _forbidden)
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            jid = c.submit(dict(spec, seed=6))["job_id"]
+            st = c.wait(jid, timeout_s=120)
+            assert st["state"] == "done", json.dumps(st)
+            m = c.metrics()
+        assert m["counters"].get("bucket_peer_hits", 0) == 1
+        assert m["counters"].get("bucket_peers_added", 0) == 1
+        assert m["counters"].get("bucket_misses", 0) == 0
+        # a LEAVEd store member is dropped from the peer list (later
+        # cold misses must not burn the peer timeout on its corpse)
+        d.membership.leave(host="127.0.0.1", port=sup.slots[0].port)
+        _wait_for(lambda: svc.buckets.peers == [], msg="peer removal")
+    finally:
+        svc.shutdown()
+        _shutdown(d, sup)
+
+
+# --- the heal canary ----------------------------------------------------------
+
+def test_self_heal_end_to_end(proven, tmp_path):
+    """THE acceptance canary: 3 supervised workers, one SIGKILLed
+    mid-FFT1 by the `kill:at=proc` chaos plane. The prove replans and
+    finishes byte-identical to the host oracle; the supervisor respawns
+    the victim; it re-JOINs in place (warm stats present) and the fleet
+    heals back to full width."""
+    from distributed_plonk_tpu.prover import prove
+
+    ckt, pk, vk, proof_host = proven
+    metrics = Metrics()
+    kill_at = []
+    faults = FaultInjector(
+        [Rule("kill", tag=protocol.FFT1, worker=1, nth=1, plane="proc")],
+        metrics=metrics)
+    store_dirs = [str(tmp_path / f"w{i}") for i in range(3)]
+    d, sup, metrics = _supervised(3, metrics=metrics, faults=faults,
+                                  store_dirs=store_dirs)
+
+    proc_kill = sup.proc_killer(d)
+
+    def stamped_kill(i):
+        kill_at.append(time.perf_counter())
+        proc_kill(i)
+    faults.proc_kill_cb = stamped_kill
+    try:
+        proof = prove(random.Random(1), ckt, pk,
+                      RemoteBackend(d, dist_fft_min=ckt.n))
+        assert proof.opening_proof == proof_host.opening_proof
+        assert proof.shifted_opening_proof \
+            == proof_host.shifted_opening_proof
+        assert proof.wires_poly_comms == proof_host.wires_poly_comms
+        assert proof.split_quot_poly_comms \
+            == proof_host.split_quot_poly_comms
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("faults_injected_kill", 0) == 1
+        assert len(kill_at) == 1
+
+        def _healed():
+            return len(d.tracker.usable_set()) == 3 and all(
+                w.probe(timeout_ms=2000) is not None for w in d.workers)
+        _wait_for(_healed, interval=0.1, msg="heal to full width")
+        heal_s = time.perf_counter() - kill_at[0]
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("worker_respawns", 0) >= 1
+        assert snap.get("membership_rejoins", 0) >= 1
+        # the respawned member rejoined warm (store sync ran; with only
+        # empty peer stores it still reports the stats envelope)
+        warm = _wait_for(
+            lambda: (d.workers[1].probe(timeout_ms=5000) or {}).get("warm"),
+            interval=0.2, msg="warm stats on the rejoined worker")
+        assert "warm_rejoin_s" in warm
+        # a healed fleet serves a follow-up prove at full width
+        proof2 = prove(random.Random(1), ckt, pk,
+                       RemoteBackend(d, dist_fft_min=ckt.n))
+        assert proof2.opening_proof == proof_host.opening_proof
+        assert heal_s < _LOAD_BUDGET_S
+    finally:
+        _shutdown(d, sup)
