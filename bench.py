@@ -713,6 +713,40 @@ def service_roundtrip_main():
                                "number (ROADMAP sweep)"),
         }
 
+    def self_verify_ab(gates=60):
+        """In-run verify-before-serve A/B (ISSUE 13): the same toy job
+        proved with DPT_SELF_VERIFY=1 (host pairing verifier gating the
+        DONE record) vs =0, same process — the overhead number operators
+        use to decide whether always-verify is affordable for their
+        shapes. Bytes must be identical either way."""
+        def run(self_verify, seed):
+            svc = ProofService(port=0, prover_workers=1,
+                               self_verify=self_verify)
+            svc.start()
+            try:
+                t0 = time.perf_counter()
+                job = svc.submit_local({"kind": "toy", "gates": gates,
+                                        "seed": seed})
+                ok = job.done_event.wait(timeout=240) \
+                    and job.state == "done"
+                dt = time.perf_counter() - t0
+                snap = svc.metrics.snapshot()
+                return ok, dt, job.proof_bytes, snap
+            finally:
+                svc.shutdown()
+        ok_off, t_off, bytes_off, _ = run("0", 71)
+        ok_on, t_on, bytes_on, m_on = run("1", 71)
+        hist = m_on["histograms"].get("self_verify_s", {})
+        return {
+            "self_verify_overhead_pct":
+                round(100.0 * (t_on - t_off) / t_off, 2) if t_off else None,
+            "self_verify_s": hist.get("mean_s"),
+            "self_verify_bytes_identical":
+                bool(ok_off and ok_on and bytes_off == bytes_on),
+            "self_verify_checks":
+                m_on["counters"].get("self_verify_checks", 0),
+        }
+
     try:
         cold_s, st, header, blob, m_cold, trace_info = one_run(seed=42)
         warm_s, st_w, _hw, _bw, m_warm, _tw = one_run(seed=43)
@@ -722,6 +756,11 @@ def service_roundtrip_main():
         except Exception as e:  # diagnostic; never fail the canary
             batch_ab = {"batch_ab_error": repr(e),
                         "batch_prove_byte_identical": False}
+        try:
+            sv_ab = self_verify_ab()
+        except Exception as e:  # diagnostic; never fail the canary
+            sv_ab = {"self_verify_ab_error": repr(e),
+                     "self_verify_overhead_pct": None}
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
@@ -756,6 +795,8 @@ def service_roundtrip_main():
                 k: v for k, v in sorted(m_cold["counters"].items())
                 if k.startswith(("placement_", "batch_", "submesh_"))},
             **batch_ab,
+            # verify-before-serve overhead (the ISSUE 13 in-run A/B)
+            **sv_ab,
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
             "service_jobs_completed":
@@ -958,6 +999,123 @@ def fleet_heal_main():
         d.pool.shutdown(wait=False)
 
 
+def sdc_heal_main():
+    """The result-integrity regression canary (ISSUE 13): 3 SUPERVISED
+    workers, one silently corrupting its MSM partials (data-plane SDC —
+    well-formed wrong answers). Mid-prove the integrity plane must
+    detect it (duplicate execution), attribute + quarantine the liar
+    (LEAVE reason=integrity), the supervisor replaces the process, and
+    the respawn re-enters through the known-answer challenge — with the
+    proof byte-identical to the host oracle throughout. Measures
+    sdc_heal_s: first quarantine verdict -> fleet back at full
+    SCHEDULABLE width. Prints one JSON line; entirely jax-free."""
+    import random as _random
+    import threading as _threading
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                          RemoteBackend)
+    from distributed_plonk_tpu.runtime.health import LivenessTracker
+    from distributed_plonk_tpu.runtime.integrity import FleetIntegrity
+    from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+    from distributed_plonk_tpu.runtime.supervisor import WorkerSupervisor
+    from distributed_plonk_tpu.service.jobs import JobSpec, build_circuit, \
+        build_bucket_keys
+    from distributed_plonk_tpu.service.metrics import Metrics
+
+    spec = JobSpec.from_wire({"kind": "toy", "gates": 16, "seed": 7})
+    ckt = build_circuit(spec)
+    _srs, pk, _vk = build_bucket_keys(spec)
+    proof_host = prove(_random.Random(1), ckt, pk, PythonBackend())
+
+    metrics = Metrics()
+    d = Dispatcher(NetworkConfig([]), metrics=metrics,
+                   integrity=FleetIntegrity(metrics=metrics,
+                                            msm_dup_rate=1.0,
+                                            rng=_random.Random(0xB)))
+    d.tracker = LivenessTracker(0, breaker_k=2, probe_base_s=0.05,
+                                probe_max_s=0.5, metrics=metrics)
+    mserver = d.enable_membership()
+    corrupt_spawns = []
+
+    def spawn_cmd(i, slot):
+        cmd = [sys.executable, "-m",
+               "distributed_plonk_tpu.runtime.worker",
+               "--join", f"127.0.0.1:{mserver.port}",
+               "--listen", f"127.0.0.1:{slot.port}",
+               "--backend", "python"]
+        if i == 1 and not corrupt_spawns:
+            corrupt_spawns.append(time.monotonic())
+            cmd = ["env", "DPT_FAULTS=corrupt:at=data:tag=MSM:rate=1"] \
+                + cmd
+        return cmd
+
+    sup = WorkerSupervisor("127.0.0.1", mserver.port, n=3,
+                           metrics=metrics, cwd=REPO,
+                           spawn_cmd=spawn_cmd).start()
+    sup.attach_registry(d.membership)
+
+    stamps = {}
+
+    def watch_detect():
+        # stamp the first quarantine verdict (the heal clock's zero)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and "detect" not in stamps:
+            if metrics.snapshot()["counters"].get(
+                    "workers_quarantined", 0) >= 1:
+                stamps["detect"] = time.perf_counter()
+                return
+            time.sleep(0.01)
+    watcher = _threading.Thread(target=watch_detect, daemon=True)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(d.workers) == 3 \
+                    and len(d.tracker.usable_set()) == 3:
+                break
+            time.sleep(0.1)
+        for w in d.workers:
+            w.RECONNECT_TRIES = 2
+            w.BACKOFF_BASE_S = 0.01
+            w.BACKOFF_MAX_S = 0.05
+        watcher.start()
+        proof = prove(_random.Random(1), ckt, pk,
+                      RemoteBackend(d, dist_fft_min=ckt.n))
+        proof_ok = (proof.opening_proof == proof_host.opening_proof
+                    and proof.shifted_opening_proof
+                    == proof_host.shifted_opening_proof
+                    and proof.wires_poly_comms == proof_host.wires_poly_comms)
+        healed = False
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if len(d.tracker.usable_set()) == 3:
+                healed = True
+                stamps.setdefault("healed", time.perf_counter())
+                break
+            time.sleep(0.05)
+        ctr = metrics.snapshot()["counters"]
+        heal_s = (stamps["healed"] - stamps["detect"]
+                  if healed and "detect" in stamps else None)
+        print(json.dumps({
+            "sdc_detected_ok": bool(
+                proof_ok and healed
+                and ctr.get("workers_quarantined", 0) >= 1
+                and ctr.get("integrity_failures", 0) >= 1
+                and ctr.get("integrity_challenges", 0) >= 1
+                and ctr.get("worker_respawns", 0) >= 1),
+            "sdc_heal_s": round(heal_s, 3) if heal_s is not None else None,
+            "sdc_phase": "corrupt@MSM (data plane, rate=1)",
+            "sdc_counters": {
+                k: v for k, v in sorted(ctr.items())
+                if k.startswith(("integrity_", "workers_quarantined",
+                                 "membership_", "worker_", "fleet_"))},
+        }))
+    finally:
+        sup.stop()
+        d.shutdown()
+        d.pool.shutdown(wait=False)
+
+
 # --- outer harness (no jax imports past this line) ---------------------------
 
 def _probe_device(timeout_s):
@@ -1118,6 +1276,27 @@ def _measure_fleet_heal():
                 "fleet_heal_error": repr(e)}
 
 
+def _measure_sdc_heal():
+    """Run sdc_heal_main in a scrubbed-CPU subprocess; returns its keys
+    or {sdc_detected_ok: False, sdc_error} — every bench line records
+    whether injected silent data corruption is detected, attributed,
+    quarantined, and healed with byte-identical proof bytes. Never
+    fails the bench."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sdc-heal"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True, text=True,
+            timeout=int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300")))
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            if line.strip().startswith("{"):
+                return json.loads(line)
+        return {"sdc_detected_ok": False, "sdc_heal_s": None,
+                "sdc_error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:
+        return {"sdc_detected_ok": False, "sdc_heal_s": None,
+                "sdc_error": repr(e)}
+
+
 def _measure_service_roundtrip():
     """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
     keys, or {service_error} — the bench line never fails on it."""
@@ -1150,6 +1329,9 @@ def main():
     if "--fleet-heal" in sys.argv:
         fleet_heal_main()
         return
+    if "--sdc-heal" in sys.argv:
+        sdc_heal_main()
+        return
     try:
         os.remove(_PARTIAL)
     except OSError:
@@ -1168,6 +1350,7 @@ def main():
         svc_box.update(_measure_service_roundtrip())
         svc_box.update(_measure_fleet_chaos())
         svc_box.update(_measure_fleet_heal())
+        svc_box.update(_measure_sdc_heal())
         svc_box.update(_measure_analysis_clean())
 
     svc_thread = threading.Thread(target=_side_measurements, daemon=True)
@@ -1176,7 +1359,7 @@ def main():
     def svc():
         svc_thread.join(
             timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300"))
-            + 2 * int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
+            + 3 * int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
             + int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")) + 30)
         out = dict(svc_box)
         if not any(k.startswith("service") for k in out):
@@ -1189,6 +1372,10 @@ def main():
             out["fleet_healed_ok"] = False
             out["fleet_heal_s"] = None
             out["fleet_heal_error"] = "did not finish"
+        if "sdc_detected_ok" not in out:
+            out["sdc_detected_ok"] = False
+            out["sdc_heal_s"] = None
+            out["sdc_error"] = "did not finish"
         if "analysis_clean" not in out:
             out["analysis_clean"] = False
             out["analysis_detail"] = "did not finish"

@@ -335,10 +335,35 @@ def _curve_entries():
     ]
 
 
+def _eval_entries():
+    """The partial-evaluation (Horner-at-r) kernel: prover round 4's
+    device evaluation (prover_jax.poly_eval — block Horner + log-depth
+    power combine), which the result-integrity plane (ISSUE 13) now also
+    uses as the distributed-EVAL serving kernel on jax workers and as
+    the per-chunk shape duplicate-executed across workers. Proved at an
+    exact-chunk width and at the prover's real blinded n+2 width (the
+    chunked reshape pads internally — both the padded and unpadded
+    tails are obligations)."""
+    from ..backend import prover_jax as PJ
+
+    out = []
+    for L in (256, 66):  # one full chunk; the n=64 blinded n+2 width
+        out.append(Entry(
+            f"eval/horner_at_r_n{L}",
+            lambda p, z: PJ.poly_eval(p, z),
+            (limb_rows(16, L), limb_rows(16, 1)), [(0, U16)]))
+    # the batched round-4 launch shape (B polys, one point each)
+    out.append(Entry(
+        "eval/horner_at_r_batch4_n66",
+        lambda p, z: PJ.poly_eval_many(p, z),
+        (limb_rows(4, 16, 66), limb_rows(4, 16, 1)), [(0, U16)]))
+    return out
+
+
 def build_registry():
     """All production entries (list of Entry)."""
     return (_field_entries() + _field_pallas_entries() + _ntt_entries()
-            + _msm_entries() + _curve_entries())
+            + _msm_entries() + _curve_entries() + _eval_entries())
 
 
 def run_bounds(strict=True, names=None, progress=None, contracts=True):

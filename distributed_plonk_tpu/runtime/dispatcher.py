@@ -36,9 +36,15 @@ from contextlib import nullcontext
 from . import native, protocol
 from .faults import FaultInjector
 from .health import LivenessTracker, NullMetrics
+from .integrity import FleetIntegrity, IntegrityError, power_sum
 from .. import curve as C
 from ..backend.python_backend import PythonBackend
+from ..constants import R_MOD
 from ..trace import merge_traces
+
+# worker-side base-set id reserved for known-answer challenges: range ids
+# are fleet positions (small ints), so a huge constant can never collide
+CHALLENGE_SET_ID = 1 << 62
 
 
 def _split_rc(n):
@@ -236,8 +242,18 @@ class Dispatcher:
 
     FFT_QUORUM = int(os.environ.get("DPT_FFT_QUORUM", "2"))
 
-    def __init__(self, config, metrics=None, faults=None, tracer=None):
+    def __init__(self, config, metrics=None, faults=None, tracer=None,
+                 integrity=None):
         self.metrics = metrics or NullMetrics()
+        # result-integrity plane (runtime/integrity.py): algebraic phase
+        # checks on every sharded FFT / NTT offload, duplicate-execution
+        # sampling + group-law sanity on MSM partials, dup-checked
+        # distributed round-4 evaluation, and quarantine of attributed
+        # liars. DPT_INTEGRITY=0 (or integrity=False) turns the whole
+        # plane off — legacy wire bytes, zero extra host math.
+        if integrity is None:
+            integrity = FleetIntegrity.from_env(metrics=self.metrics)
+        self.integrity = integrity or None
         if faults is None:
             # env-driven chaos (DPT_FAULTS="drop:tag=NTT;delay:tag=MSM:ms=50")
             # for soaks against a live deployment; None when unset, so the
@@ -312,8 +328,13 @@ class Dispatcher:
             w.call(protocol.PING)
 
     def health(self):
-        """Fresh-probe HEALTH snapshot per worker (None = unreachable)."""
-        return [w.probe() for w in self.workers]
+        """Fresh-probe HEALTH snapshot per worker (None = unreachable),
+        annotated with the dispatcher-side quarantine verdict."""
+        snaps = [w.probe() for w in self.workers]
+        for i, s in enumerate(snaps):
+            if s is not None:
+                s["suspect"] = self.tracker.is_suspect(i)
+        return snaps
 
     # -- liveness maintenance -------------------------------------------------
 
@@ -447,13 +468,17 @@ class Dispatcher:
                 raise ConnectionError(f"range {i} never provisioned")
             # an adopted range routes straight to its new owner — no
             # re-dialing the dead worker, no re-upload
-            w = self.workers[self._adopted.get(i, i)]
-            raw = w.call(protocol.MSM,
-                         protocol.encode_msm_request(i, chunk),
-                         parent=fleet_sid)
-            return protocol.decode_point(raw)
+            server = self._adopted.get(i, i)
+            raw = self.workers[server].call(
+                protocol.MSM, protocol.encode_msm_request(i, chunk),
+                parent=fleet_sid)
+            return protocol.decode_point(raw), server
 
-        total = None
+        # per-range (partial point, serving worker) — kept apart until
+        # the integrity pass has inspected EVERY partial (primary AND
+        # recovery-path adopted — the PR 12 stale-base class must be
+        # caught on the recovery path too), only then folded
+        results = [None] * len(self._ranges)
         failed = []
         # ranges, not workers: a member that joined after init_bases()
         # holds no range yet (it becomes an adopter/full member at the
@@ -463,15 +488,152 @@ class Dispatcher:
             if isinstance(res, _Failure):
                 failed.append(i)
             else:
-                total = C.g1_add_affine(total, res)
+                results[i] = res
         if failed:
             # recoveries run concurrently; _recover_msm spreads adoptions
             # across the fleet starting at dead_i + 1
-            for p in self.pool.map(
+            for i, rec in zip(failed, self.pool.map(
                     lambda i: self._recover_msm(i, scalars, fleet_sid),
-                    failed):
-                total = C.g1_add_affine(total, p)
+                    failed)):
+                results[i] = rec
+        if self.integrity is not None:
+            results = list(self.pool.map(
+                lambda ir: self._msm_check_range(ir[0], ir[1], scalars,
+                                                 fleet_sid),
+                enumerate(results)))
+        total = None
+        for rec in results:
+            if rec is not None:
+                total = C.g1_add_affine(total, rec[0])
         return total
+
+    def _msm_check_range(self, i, rec, scalars, fleet_sid=None):
+        """Integrity pass for one served MSM partial: group-law sanity
+        (on-curve + subgroup) always, duplicate execution at the sampled
+        rate (DPT_INTEGRITY_MSM_DUP). A worker caught serving a wrong
+        partial is quarantined and the range recomputed on a healthy
+        adopter (whose result is sanity-checked in turn). Returns the
+        (partial, server) record to fold — possibly replaced."""
+        if rec is None:
+            return None
+        integ = self.integrity
+        point, server = rec
+        integ.metrics.inc("integrity_checks")
+        if not integ.point_sane(point):
+            # a flipped coordinate limb: not even on the curve (or not
+            # in the order-r subgroup) — attribution is immediate
+            integ.metrics.inc("integrity_failures")
+            self.quarantine(server, f"msm range {i}: partial fails the "
+                                    "group-law sanity check")
+            return self._msm_requarantine_recompute(i, scalars, fleet_sid)
+        if not integ.sample_msm_dup():
+            return rec
+        integ.metrics.inc("integrity_msm_dups")
+        verdict = self._msm_dup_check(i, point, server, scalars, fleet_sid)
+        if verdict is None:
+            return rec  # agreed (or no second worker to ask)
+        liar, good = verdict
+        integ.metrics.inc("integrity_failures")
+        self.quarantine(liar, f"msm range {i}: duplicate execution "
+                              "mismatch")
+        if liar != server:
+            return rec  # the verifier lied; the served partial stands
+        if good is not None:
+            return good
+        return self._msm_requarantine_recompute(i, scalars, fleet_sid)
+
+    def _msm_requarantine_recompute(self, i, scalars, fleet_sid):
+        """Recompute range i after its server was quarantined: the
+        normal adoption path (fresh bases pushed to a healthy worker),
+        with the new partial re-checked — group-law sanity AND one
+        duplicate execution (the adopter may be lying too; found live in
+        the sdc soak, where the unchecked recompute was the one path a
+        wrong partial could ride into the fold — self-verify caught it,
+        but the phase boundary should). A second failure means the fleet
+        cannot serve trustworthy data for this range — loud
+        IntegrityError, never a silent wrong fold."""
+        rec = self._recover_msm(i, scalars, fleet_sid)
+        if rec is None:
+            return None
+        if not self.integrity.point_sane(rec[0]):
+            self.integrity.metrics.inc("integrity_failures")
+            self.quarantine(rec[1], f"msm range {i}: recomputed partial "
+                                    "fails the group-law sanity check")
+            raise IntegrityError(
+                f"msm range {i}: no trustworthy partial", (rec[1],))
+        verdict = self._msm_dup_check(i, rec[0], rec[1], scalars, fleet_sid)
+        if verdict is not None:
+            liar, good = verdict
+            self.integrity.metrics.inc("integrity_failures")
+            self.quarantine(liar, f"msm range {i}: recomputed partial "
+                                  "duplicate mismatch")
+            if liar != rec[1]:
+                return rec
+            if good is not None:
+                return good
+            raise IntegrityError(
+                f"msm range {i}: no trustworthy partial", (liar,))
+        return rec
+
+    def _msm_dup_check(self, i, point, server, scalars, fleet_sid=None):
+        """Duplicate-execute range i on a second worker with FRESHLY
+        pushed bases and compare. None = partials agree (or nobody to
+        ask). On a mismatch, a third worker votes (host oracle referees
+        small ranges when the fleet is only 2 wide): returns
+        (liar_index, (good_point, good_server) | None)."""
+        start, end = self._ranges[i]
+        chunk = scalars[start:end]
+
+        def compute_on(j):
+            w = self.workers[j]
+            w.call(protocol.INIT_BASES,
+                   protocol.encode_init_bases(i, self._bases[start:end]),
+                   parent=fleet_sid)
+            raw = w.call(protocol.MSM,
+                         protocol.encode_msm_request(i, chunk),
+                         parent=fleet_sid)
+            return protocol.decode_point(raw)
+
+        k = len(self.workers)
+        candidates = [j for j in ((server + off) % k
+                                  for off in range(1, k))
+                      if j != server and self.tracker.usable(j)]
+        verifier = dup = None
+        for j in candidates:
+            try:
+                dup = compute_on(j)
+                verifier = j
+                break
+            except Exception:
+                continue
+        if verifier is None:
+            return None  # nobody to cross-check against: unsampled
+        if dup == point:
+            return None
+        # disagreement: one of the two is lying — get a third opinion
+        for j in candidates:
+            if j == verifier:
+                continue
+            try:
+                ref = compute_on(j)
+            except Exception:
+                continue
+            if ref == dup:
+                return server, (dup, verifier)
+            if ref == point:
+                return verifier, None
+            break  # three-way disagreement: fall through to conservative
+        if len(chunk) <= self.integrity.referee_max:
+            ref = C.g1_msm(self._bases[start:end][:len(chunk)], chunk)
+            if ref == dup:
+                return server, (dup, verifier)
+            if ref == point:
+                return verifier, None
+        # unattributable beyond doubt: the worker SERVING the data is
+        # the one whose wrong answer would poison the proof — quarantine
+        # it and recompute (conservative; an innocent server rejoins via
+        # the challenge gate)
+        return server, None
 
     def _recover_msm(self, dead_i, scalars, fleet_sid=None):
         """Re-provision range dead_i's bases onto a healthy worker (set id
@@ -481,7 +643,11 @@ class Dispatcher:
         only if NO usable worker can adopt are the breaker-open ones
         probed directly and re-admitted on an answer — same last-resort
         rule as ntt(): a recovered fleet whose breakers are all still
-        open must serve the call, not abort the prove."""
+        open must serve the call, not abort the prove.
+
+        Returns (partial point, adopting worker) — the adopter rides
+        along so the integrity pass can attribute/quarantine adopted
+        ranges exactly like primary ones."""
         start, end = self._ranges[dead_i]
         chunk = scalars[start:end]
         if not chunk:
@@ -507,7 +673,7 @@ class Dispatcher:
             self._adopted[dead_i] = j
             self._unprovisioned.discard(dead_i)  # freshly pushed to j
             self.metrics.inc("fleet_range_adoptions")
-            return protocol.decode_point(raw)
+            return protocol.decode_point(raw), j
 
         rotation = [(dead_i + off) % k for off in range(1, k + 1)]
         for j in rotation:
@@ -535,12 +701,82 @@ class Dispatcher:
         call, not fast-fail it (call() alone would raise
         WorkerUnavailable without dialing)."""
         for i in candidates:
-            if self._left(i):
-                continue  # decommissioned: only a JOIN revives it
+            if self._left(i) or self.tracker.is_suspect(i):
+                continue  # decommissioned/quarantined: a JOIN (plus, for
+                # suspects, a passed challenge) is the only way back
             if self.workers[i].probe() is None:
                 continue  # actually dead: leave the breaker open
             self.tracker.record_ok(i)  # alive: re-admit, then route to it
             yield i
+
+    # -- result-integrity quarantine ------------------------------------------
+
+    def quarantine(self, i, reason):
+        """The integrity plane attributed a WRONG answer to worker i:
+        mark it SUSPECT (sticky breaker — probes do NOT re-admit it, its
+        process is alive and answering; its answers are wrong), and
+        LEAVE it through the membership registry so the supervisor
+        replaces the process (flap-cap rules apply to repeat offenders).
+        Re-admission is only via a fresh JOIN that passes the
+        known-answer challenge (run_challenge)."""
+        flipped = self.tracker.mark_suspect(i)
+        self.workers[i].drop_conn()
+        if self.tracer is not None:
+            self.tracer.add_event("integrity/quarantine", time.time(), 0.0,
+                                  worker=i, reason=reason)
+        if self.membership is not None and flipped:
+            try:
+                self.membership.leave(index=i, reason="integrity")
+            except Exception:  # registry races a concurrent leave: fine
+                pass
+        return flipped
+
+    def run_challenge(self, host, port, timeout_s=15.0):
+        """Known-answer gate for (re-)admitting a worker the integrity
+        plane quarantined: a fresh random NTT and a fresh random MSM,
+        both compared against the host oracle. Values are drawn per call
+        so a lying worker cannot replay cached answers. Retries the
+        connection while the (just-respawned) worker binds."""
+        from .. import poly as P
+        rng = random.Random()
+        xs = [rng.randrange(R_MOD) for _ in range(64)]
+        want_ntt = P.fft(P.Domain(64), xs)
+        bases = [C.g1_mul(C.G1_GEN, k + 2) for k in range(8)]
+        sc = [rng.randrange(R_MOD) for _ in range(8)]
+        want_msm = C.g1_msm(bases, sc)
+        self.metrics.inc("integrity_challenges")
+        h = WorkerHandle(host, port, metrics=self.metrics)
+        deadline = time.monotonic() + timeout_s
+        try:
+            while True:
+                try:
+                    got_ntt = protocol.decode_scalars(h.call(
+                        protocol.NTT,
+                        protocol.encode_ntt_request(xs, False, False),
+                        traced=False))
+                    h.call(protocol.INIT_BASES,
+                           protocol.encode_init_bases(CHALLENGE_SET_ID,
+                                                      bases), traced=False)
+                    got_msm = protocol.decode_point(h.call(
+                        protocol.MSM,
+                        protocol.encode_msm_request(CHALLENGE_SET_ID, sc),
+                        traced=False))
+                    break
+                except (ConnectionError, OSError):
+                    if time.monotonic() >= deadline:
+                        self.metrics.inc("integrity_challenges_failed")
+                        return False
+                    h.drop_conn()
+                    time.sleep(0.2)
+                except RuntimeError:  # worker ERR reply: that's a fail
+                    self.metrics.inc("integrity_challenges_failed")
+                    return False
+        finally:
+            h.close()
+        ok = got_ntt == want_ntt and got_msm == want_msm
+        if not ok:
+            self.metrics.inc("integrity_challenges_failed")
+        return ok
 
     # -- NTT ------------------------------------------------------------------
 
@@ -558,19 +794,36 @@ class Dispatcher:
         self._maybe_readmit()
         rotation = [(worker + off) % k for off in range(k)]
         last_err = None
+
+        def served_by(i):
+            """One attempt on worker i, integrity-checked: a wrong (but
+            well-formed) result quarantines the server and raises so the
+            rotation tries the next worker — attribution is trivial
+            here, exactly one worker computed the answer."""
+            raw = self.workers[i].call(protocol.NTT, payload)
+            out = protocol.decode_scalars(raw)
+            if self.integrity is not None \
+                    and self.integrity.sample_ntt_check():
+                t = self.integrity.draw_point()
+                if not self.integrity.check_transform(values, out, t,
+                                                      inverse, coset):
+                    self.quarantine(i, "ntt result fails the "
+                                       "Schwartz-Zippel check")
+                    raise IntegrityError(
+                        f"worker {i} served a wrong NTT", (i,))
+            return out
+
         with self._span("fleet/ntt", n=len(values), inverse=inverse,
                         coset=coset):
             for i in [i for i in rotation if self.tracker.usable(i)]:
                 try:
-                    raw = self.workers[i].call(protocol.NTT, payload)
-                    return protocol.decode_scalars(raw)
+                    return served_by(i)
                 except Exception as e:
                     last_err = e
             for i in self._probe_readmit(
                     i for i in rotation if not self.tracker.usable(i)):
                 try:
-                    raw = self.workers[i].call(protocol.NTT, payload)
-                    return protocol.decode_scalars(raw)
+                    return served_by(i)
                 except Exception as e:
                     last_err = e
         raise RuntimeError("no worker could serve the NTT") from last_err
@@ -582,6 +835,99 @@ class Dispatcher:
         return list(self.pool.map(
             lambda ij: self.ntt(ij[1][0], ij[1][1], ij[1][2], worker=ij[0]),
             enumerate(jobs)))
+
+    # -- distributed evaluation (round 4) -------------------------------------
+
+    def eval_many(self, pairs):
+        """[(coeffs, point)] -> evaluations, each polynomial's Horner
+        sum range-sharded across the fleet (worker j returns
+        sum_i chunk[i] * point^i; the host scales by point^start and
+        folds). Exact field math — byte-identical to a host evaluation.
+        ALL pairs' chunks ride ONE executor fan-out (round 4 submits 10
+        polys at once; sequencing them would serialize 10 scatter/gather
+        barriers onto the hot path). Integrity: chunks are duplicate-
+        executed at the sampled rate and a mismatch is refereed by the
+        host (a chunk evaluation is O(n/k) host muls — always
+        affordable), so attribution is exact; a dead worker's chunk
+        silently falls back to the host referee too."""
+        usable = self.tracker.usable_set()
+        k = max(len(usable), 1)
+        plans = []   # (coeffs, point, bounds | None); None = host path
+        for coeffs, point in pairs:
+            coeffs = [int(v) % R_MOD for v in coeffs]
+            point = int(point) % R_MOD
+            n = len(coeffs)
+            if not usable or n < 4 * k:
+                plans.append((coeffs, point, None))
+            else:
+                plans.append((coeffs, point,
+                              [n * j // k for j in range(k + 1)]))
+        flat = [(pi, j) for pi, (_c, _p, b) in enumerate(plans)
+                if b is not None for j in range(k)]
+        out = [0] * len(pairs)
+        if flat:
+            total_n = sum(len(c) for c, _p, b in plans if b is not None)
+            with self._span("fleet/eval", n=total_n,
+                            polys=len(pairs)) as sid:
+                def one(arg):
+                    pi, j = arg
+                    coeffs, point, bounds = plans[pi]
+                    lo, hi = bounds[j], bounds[j + 1]
+                    if hi <= lo:
+                        return 0
+                    chunk = coeffs[lo:hi]
+                    server = usable[j]
+                    try:
+                        val = self._eval_chunk(server, chunk, point, sid)
+                    except Exception:
+                        # dead/unreachable worker: the host referee is
+                        # the fallback — eval must never fail the prove
+                        return power_sum(chunk, point) \
+                            * pow(point, lo, R_MOD) % R_MOD
+                    if self.integrity is not None:
+                        val = self._eval_integrity(j, server, chunk,
+                                                   point, val, usable,
+                                                   sid)
+                    return val * pow(point, lo, R_MOD) % R_MOD
+
+                for (pi, _j), part in zip(flat, self.pool.map(one, flat)):
+                    out[pi] = (out[pi] + part) % R_MOD
+        for pi, (coeffs, point, b) in enumerate(plans):
+            if b is None:
+                out[pi] = power_sum(coeffs, point)
+        return out
+
+    def eval_poly(self, coeffs, point):
+        return self.eval_many([(coeffs, point)])[0]
+
+    def _eval_chunk(self, i, chunk, point, sid=None):
+        raw = self.workers[i].call(
+            protocol.EVAL, protocol.encode_eval_request(point, chunk),
+            parent=sid)
+        return protocol.decode_scalar(raw) % R_MOD
+
+    def _eval_integrity(self, j, server, chunk, point, val, usable,
+                        sid=None):
+        """Duplicate-execution sampling for one evaluation chunk. On a
+        mismatch the host referee (exact, cheap) names the liar; the
+        refereed value is what gets served either way."""
+        integ = self.integrity
+        integ.metrics.inc("integrity_checks")
+        if not integ.sample_msm_dup() or len(usable) < 2:
+            return val
+        integ.metrics.inc("integrity_eval_dups")
+        verifier = usable[(j + 1) % len(usable)]
+        try:
+            dup = self._eval_chunk(verifier, chunk, point, sid)
+        except Exception:
+            return val  # nobody answered the cross-check: unsampled
+        if dup == val:
+            return val
+        integ.metrics.inc("integrity_failures")
+        ref = power_sum(chunk, point)
+        liar = server if ref != val else verifier
+        self.quarantine(liar, "eval chunk duplicate execution mismatch")
+        return ref
 
     # -- sharded 4-step FFT ---------------------------------------------------
 
@@ -702,7 +1048,7 @@ class Dispatcher:
                 protocol.FFT_INIT, protocol.encode_fft_init(
                     task_id, inverse, coset, n, r, c,
                     row_bounds[i][0], row_bounds[i][1], col_ranges,
-                    epoch=epoch),
+                    epoch=epoch, integrity=self.integrity is not None),
                 parent=fft_sid),
             active)
 
@@ -724,12 +1070,27 @@ class Dispatcher:
                 parent=fft_sid),
             active)
 
+        # integrity: a random Fr check point rides every FFT2 fetch; the
+        # workers piggyback (input-side, output-side) partial power sums
+        # at that point on their replies (attribution evidence), and the
+        # GATHERED output — the data actually served — must satisfy the
+        # closed-form Schwartz-Zippel identity against the input
+        check_t = self.integrity.draw_point() \
+            if self.integrity is not None else None
+        claimed = {}
+
         def gather(i):
             cs, ce = col_ranges[i]
             if ce == cs:
                 return i, None
-            flat = protocol.decode_scalar_matrix(self.workers[i].call(
-                protocol.FFT2, struct.pack("<Q", task_id), parent=fft_sid))
+            raw = self.workers[i].call(
+                protocol.FFT2,
+                protocol.encode_fft2_request(task_id, check_t),
+                parent=fft_sid)
+            partials, panel = protocol.split_fft2_reply(raw)
+            if partials is not None:
+                claimed[i] = partials  # distinct keys: no lock needed
+            flat = protocol.decode_scalar_matrix(panel)
             return i, flat
 
         out = np.empty((16, r, c), dtype=np.uint32)  # [16, k1, k2]
@@ -748,8 +1109,23 @@ class Dispatcher:
                 f"fft gather lost {len(failures)} worker(s)") \
                 from failures[0].err
         # result index is k1 + r*k2 -> transpose to [k2, k1] before flatten
-        return protocol.matrix_to_ints(
+        result = protocol.matrix_to_ints(
             np.ascontiguousarray(out.transpose(0, 2, 1)).reshape(16, n))
+        if check_t is not None and not self.integrity.check_transform(
+                values, result, check_t, inverse, coset):
+            # detection is O(n); attribution (per-panel bisection against
+            # the closed-form panel expectation, plus the workers' own
+            # claimed partial pairs) runs only now, on the failed check
+            suspects = self.integrity.attribute_fft(
+                values, result, check_t, col_ranges, r, c, inverse, coset,
+                claimed=claimed, row_bounds=row_bounds)
+            for s in suspects:
+                self.quarantine(s, "fft panel fails the Schwartz-Zippel "
+                                   "check")
+            raise IntegrityError(
+                f"sharded fft integrity check failed "
+                f"(suspect workers {suspects})", suspects)
+        return result
 
     # -- tracing --------------------------------------------------------------
 
@@ -832,15 +1208,22 @@ class RemoteBackend(PythonBackend):
 
     name = "remote"
 
-    def __init__(self, dispatcher, dist_fft_min=None):
+    def __init__(self, dispatcher, dist_fft_min=None, dist_eval=None):
         """dist_fft_min: domain size at or above which a single NTT is run
         as the cross-worker sharded 4-step FFT (fft_dist) instead of being
         shipped whole to one worker; None = never (per-poly parallelism
-        only)."""
+        only). dist_eval: range-shard round-4 polynomial evaluations
+        across the fleet (Dispatcher.eval_many — exact field math, so
+        proof bytes are unchanged; duplicate-execution integrity applies);
+        default on, DPT_FLEET_EVAL=0 (or dist_eval=False) keeps
+        evaluations on the host."""
         self.d = dispatcher
         self._inited = None
         self._rr = 0  # round-robin cursor for single NTTs
         self.dist_fft_min = dist_fft_min
+        if dist_eval is None:
+            dist_eval = os.environ.get("DPT_FLEET_EVAL", "1") != "0"
+        self.dist_eval = bool(dist_eval)
 
     def _ensure_bases(self, bases):
         if self._inited is not bases:
@@ -888,3 +1271,11 @@ class RemoteBackend(PythonBackend):
 
     def commit(self, ck, coeffs):
         return self.msm(ck, coeffs)
+
+    def eval_many_h(self, pairs):
+        """Round-4 evaluations range-sharded across the fleet (exact
+        field math — bytes identical to the host path), dup-checked by
+        the integrity plane; DPT_FLEET_EVAL=0 restores the host path."""
+        if not self.dist_eval or not self.d.workers:
+            return super().eval_many_h(pairs)
+        return self.d.eval_many(pairs)

@@ -38,6 +38,26 @@ One injector object threads through both failure planes:
                       validation on files) must detect it and restart the
                       prove cleanly rather than resume garbage
 
+  data plane (`at=data`, runtime/worker.py): `on_data(worker, tag)` runs
+      WORKER-SIDE, right after a result is computed and before it is
+      framed — the silent-data-corruption model (a flipped limb from a
+      bad chip, stale device state): the worker perturbs its OWN
+      computed value (MSM partial += G1 generator; FFT2 panel / NTT /
+      EVAL element += 1 mod r), so the corruption is a WELL-FORMED wrong
+      answer under every CRC/SHA layer. `worker` matches the worker's
+      own fleet index (each worker process parses DPT_FAULTS itself);
+      `tag` matches the protocol tag whose result is being corrupted
+      (MSM, NTT, FFT2, EVAL). Only the result-integrity plane
+      (runtime/integrity.py) or the self-verify backstop can catch it:
+        DPT_FAULTS="corrupt:at=data:tag=MSM:worker=1"
+
+  proof plane (`at=proof`, service/pool.py): `on_proof(job_id)` runs in
+      the service right after a finished proof is serialized and BEFORE
+      the verify-before-serve gate — SDC between prove and serve. The
+      pool flips a byte in the proof bytes; DPT_SELF_VERIFY must block
+      it from ever reaching a journal DONE record or a client:
+        DPT_FAULTS="corrupt:at=proof:rate=0.3"
+
   journal plane (service/journal.py): `on_journal(rtype, label, job_id)`
       runs right after each job-journal record is DURABLE (fsync'd).
       `tag` matches the record type ("SUBMIT", "START", "ROUND", "DONE",
@@ -58,8 +78,8 @@ Entries are `action[:key=value]*` separated by `;`. Keys: tag (name,
 number, or — on the journal plane — a record label string), worker, nth
 (1-based occurrence; default 1), rate (probability, overrides nth), ms,
 max (max fires, default 1 for nth rules, unlimited for rate rules), at
-(plane: wire | round | journal). Occurrence counting is per-rule and
-thread-safe.
+(plane: wire | proc | round | journal | data | proof). Occurrence
+counting is per-rule and thread-safe.
 """
 
 import os
@@ -229,6 +249,40 @@ class FaultInjector:
                 if cb is not None:
                     cb(worker)
         return tag
+
+    # -- data plane (worker-side SDC) -----------------------------------------
+
+    def on_data(self, worker, tag):
+        """Worker-side hook, run between 'result computed' and 'result
+        framed': True when a matching `corrupt:at=data` rule fires — the
+        caller then perturbs the value it just computed (modeling SDC in
+        the compute path itself: everything downstream, including any
+        piggybacked integrity partials, sees the corrupted buffer)."""
+        fired = False
+        for rule in self.rules:
+            if rule.plane != "data" or rule.action != "corrupt":
+                continue
+            if not self._due(rule, tag=tag, worker=worker):
+                continue
+            self._inc("faults_injected_corrupt")
+            fired = True
+        return fired
+
+    # -- proof plane (service, post-serialize) --------------------------------
+
+    def on_proof(self, job_id=None):
+        """True when a `corrupt:at=proof` rule fires for this finished
+        proof: the pool flips a byte in the serialized proof before the
+        verify-before-serve gate sees it."""
+        fired = False
+        for rule in self.rules:
+            if rule.plane != "proof" or rule.action != "corrupt":
+                continue
+            if not self._due(rule, tag=rule.tag):
+                continue
+            self._inc("faults_injected_corrupt")
+            fired = True
+        return fired
 
     # -- checkpoint plane (prover pool) ---------------------------------------
 

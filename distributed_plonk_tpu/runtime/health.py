@@ -12,6 +12,13 @@ panics the prove). Here each worker carries a tiny state machine:
              window gets `probe_due()` True and sends a cheap HEALTH/PING
              on a fresh connection; success re-admits (CLOSED), failure
              pushes `next_probe` out exponentially (with jitter).
+    SUSPECT  quarantined (runtime/integrity.py attributed a WRONG answer
+             to it): breaker open AND sticky — a suspect worker answers
+             probes perfectly well (it is alive; its answers are wrong),
+             so `record_ok` does NOT re-admit it. Only an explicit
+             `clear_suspect` (the membership JOIN path, after the fresh
+             process passes a known-answer challenge) closes the breaker
+             again.
 
 All mutable state lives in per-worker dicts guarded by `self._lock`
 (LOCK01/02 discipline — analysis/lint.py runs over runtime/ too). The
@@ -65,7 +72,7 @@ class LivenessTracker:
     @staticmethod
     def _fresh():
         return {"open": False, "failures": 0, "next_probe": 0.0,
-                "probe_backoff": 0.0, "opens": 0}
+                "probe_backoff": 0.0, "opens": 0, "suspect": False}
 
     def add_worker(self):
         """Grow the table by one (dynamic membership: a JOIN appends a
@@ -84,9 +91,13 @@ class LivenessTracker:
 
     def record_ok(self, i):
         """A successful call: reset failures; re-admit if OPEN (the call
-        doubled as a successful probe)."""
+        doubled as a successful probe). A SUSPECT worker is NOT
+        re-admitted: it is alive and answering — its answers are wrong
+        (the whole point of quarantine); only clear_suspect revives it."""
         with self._lock:
             s = self._state[i]
+            if s["suspect"]:
+                return False
             readmitted = s["open"]
             s["open"] = False
             s["failures"] = 0
@@ -94,6 +105,39 @@ class LivenessTracker:
         if readmitted:
             self.metrics.inc("fleet_readmissions")
         return readmitted
+
+    def mark_suspect(self, i):
+        """Quarantine verdict from the integrity plane: breaker opened
+        and made STICKY. Returns True when this call flipped it."""
+        now = time.monotonic()
+        with self._lock:
+            s = self._state[i]
+            flipped = not s["suspect"]
+            s["suspect"] = True
+            opened = not s["open"]
+            s["open"] = True
+            s["failures"] = max(s["failures"], self.breaker_k)
+            if opened:
+                s["opens"] += 1
+                s["probe_backoff"] = self.probe_base_s
+                s["next_probe"] = now + self._jitter(s["probe_backoff"])
+        if flipped:
+            self.metrics.inc("workers_quarantined")
+        return flipped
+
+    def clear_suspect(self, i):
+        """Absolution (a fresh JOIN passed the known-answer challenge):
+        drop the sticky flag and close the breaker."""
+        with self._lock:
+            s = self._state[i]
+            s["suspect"] = False
+            s["open"] = False
+            s["failures"] = 0
+            s["probe_backoff"] = 0.0
+
+    def is_suspect(self, i):
+        with self._lock:
+            return self._state[i]["suspect"]
 
     def record_failure(self, i):
         """A failed call (reconnect retries exhausted). Returns True when
@@ -153,7 +197,9 @@ class LivenessTracker:
         now = time.monotonic()
         with self._lock:
             s = self._state[i]
-            if not s["open"] or now < s["next_probe"]:
+            # suspects never get half-open probes: they answer probes
+            # fine (alive, wrong), so probing can only waste a window
+            if not s["open"] or s["suspect"] or now < s["next_probe"]:
                 return False
             s["next_probe"] = now + self._jitter(
                 s["probe_backoff"] or self.probe_base_s)

@@ -65,6 +65,12 @@ class MembershipRegistry:
         # half-open probe loop must NOT re-admit these (a decommissioned
         # address may still answer probes), and must stop dialing them
         self.left = set()
+        # (host, port) addresses LEAVEd by the result-integrity plane
+        # (reason="integrity"): a fresh JOIN from one of these is only
+        # SCHEDULABLE after the known-answer challenge passes
+        # (Dispatcher.run_challenge) — a wrong-answer worker must not
+        # re-enter service just by answering its own JOIN
+        self.quarantined = set()
         self._listeners = []
         self._publish()
 
@@ -110,11 +116,19 @@ class MembershipRegistry:
         with self._lock:
             index = self._find(host, port)
             rejoin = index is not None
+            challenged = (host, port) in self.quarantined \
+                and getattr(self.d, "integrity", None) is not None
             if rejoin:
                 self.left.discard(index)  # an explicit JOIN un-leaves
-                self._readmit(index)
+                self._readmit(index, challenged=challenged)
             else:
                 index = self.d.adopt_worker(host, port)
+                if challenged:
+                    # a quarantined ADDRESS coming back under a fresh
+                    # slot is still gated (shouldn't happen — rejoins
+                    # land in place — but the gate must not be evadable)
+                    self.d.tracker.mark_suspect(index)
+                    self.d.pool.submit(self._challenge, index, host, port)
             if store:
                 self.stores[index] = True
             self.epoch += 1
@@ -129,9 +143,13 @@ class MembershipRegistry:
         self._push_roster(exclude=index)
         return reply
 
-    def leave(self, index=None, host=None, port=None):
-        """Declare a member permanently gone (flap cap / decommission):
-        breaker opened immediately, epoch bumped, slot retained."""
+    def leave(self, index=None, host=None, port=None, reason=None):
+        """Declare a member permanently gone (flap cap / decommission /
+        integrity quarantine): breaker opened immediately, epoch bumped,
+        slot retained. reason="integrity" additionally quarantines the
+        ADDRESS: its next JOIN is challenge-gated, and an attached
+        supervisor kills the (alive but lying) process so it respawns
+        clean."""
         with self._lock:
             if index is None:
                 index = self._find(host, int(port))
@@ -142,11 +160,14 @@ class MembershipRegistry:
             self.d.tracker.mark_dead(index)
             w.drop_conn()
             self.stores.pop(index, None)
+            if reason == "integrity":
+                self.quarantined.add((w.host, w.port))
             self.epoch += 1
             self.metrics.inc("membership_leaves")
             self._publish()
             event = {"event": "leave", "index": index, "host": w.host,
-                     "port": w.port, "epoch": self.epoch}
+                     "port": w.port, "epoch": self.epoch,
+                     "reason": reason}
         self._emit("membership/leave", event)
         self._push_roster(exclude=index)
         return {"epoch": self.epoch, "index": index}
@@ -165,7 +186,7 @@ class MembershipRegistry:
                 return i
         return None
 
-    def _readmit(self, index):
+    def _readmit(self, index, challenged=False):
         """Re-admission through the PR 6 path: fresh stream, breaker
         closed (counts fleet_readmissions when it was open), and the
         member's original MSM base range re-provisioned so routing
@@ -174,11 +195,47 @@ class MembershipRegistry:
         is still blocked on that reply and not yet serving, so an inline
         INIT_BASES here would deadlock the whole membership plane until
         the call timeout (found live: the supervisor then wedge-killed
-        the healthy rejoiner in a loop)."""
+        the healthy rejoiner in a loop).
+
+        challenged=True (the address was quarantined by the integrity
+        plane): the member STAYS suspect — unschedulable — until the
+        async known-answer challenge passes; same deadlock rationale,
+        the challenge dials the joiner after the reply goes out."""
         w = self.d.workers[index]
         w.drop_conn()
+        if challenged:
+            self.d.tracker.mark_suspect(index)  # idempotent; stays dark
+            self.d.pool.submit(self._challenge, index, w.host, w.port)
+            return
         self.d.tracker.record_ok(index)
         self.d.pool.submit(self.d._reprovision, index)
+
+    def _challenge(self, index, host, port):
+        """Async challenge gate for a quarantined address's fresh JOIN:
+        pass -> absolved (suspect cleared, schedulable, range
+        re-provisioned); fail -> LEAVEd again, still quarantined — a
+        worker that still serves wrong answers never re-enters service."""
+        try:
+            ok = self.d.run_challenge(host, port)
+        except Exception:
+            ok = False
+        if ok:
+            with self._lock:
+                self.quarantined.discard((host, port))
+            self.d.tracker.clear_suspect(index)
+            self.d.tracker.record_ok(index)
+            self.d._reprovision(index)
+            self._emit("membership/challenge_passed",
+                       {"event": "challenge_passed", "index": index,
+                        "host": host, "port": port})
+        else:
+            try:
+                self.leave(index=index, reason="integrity")
+            except Exception:
+                pass
+            self._emit("membership/challenge_failed",
+                       {"event": "challenge_failed", "index": index,
+                        "host": host, "port": port})
 
     def _ready(self, host, port, stats):
         with self._lock:

@@ -96,6 +96,12 @@ STORE_LIST = 31    # JSON {prefix?} -> OK + JSON {keys}: enumerate store
                    # pseudo-keys for persistent-compile-cache files) so a
                    # joining worker knows what to STORE_FETCH for its warm
                    # rejoin
+# --- result-integrity plane (runtime/integrity.py) ---------------------------
+EVAL = 32          # 32B point, u64 count, count * 32B coeffs -> reply 32B
+                   # partial Horner evaluation sum_i c_i * point^i — the
+                   # distributed round-4 evaluation chunk (the dispatcher
+                   # scales by point^start and folds; duplicate-executed
+                   # chunks cross-check workers against each other)
 OK = 100
 ERR = 101
 
@@ -235,17 +241,21 @@ def decode_msm_request(raw):
 
 
 def encode_fft_init(task_id, inverse, coset, n, r, c, rs, re, col_ranges,
-                    epoch=0):
+                    epoch=0, integrity=False):
     """col_ranges: every worker's stage-2 row range [(cs, ce)] — each worker
     needs the full table to route its peer exchange. `epoch` is the
     sender's membership-roster version (0 = no membership plane): a worker
     whose roster moved past it rejects the frame as stale, forcing the
-    dispatcher to replan on the CURRENT fleet width."""
+    dispatcher to replan on the CURRENT fleet width. `integrity` announces
+    that the dispatcher's integrity plane is armed: the worker then
+    retains its raw FFT1 input panels so the FFT2 check point can get an
+    input-side partial (a plane-off dispatcher keeps the legacy zero
+    extra memory)."""
     flags = (1 if inverse else 0) | (2 if coset else 0)
     head = struct.pack("<QBQQQQQQ", task_id, flags, n, r, c, rs, re,
                        len(col_ranges))
     body = b"".join(struct.pack("<QQ", cs, ce) for cs, ce in col_ranges)
-    return head + body + struct.pack("<Q", epoch)
+    return head + body + struct.pack("<QB", epoch, 1 if integrity else 0)
 
 
 def decode_fft_init(raw):
@@ -253,11 +263,12 @@ def decode_fft_init(raw):
     off = struct.calcsize("<QBQQQQQQ")
     col_ranges = [struct.unpack_from("<QQ", raw, off + 16 * i) for i in range(k)]
     off += 16 * k
-    # trailing epoch is optional on the wire: frames from pre-membership
-    # senders decode as epoch 0 (accepted everywhere)
+    # trailing epoch + integrity flag are optional on the wire: frames
+    # from older senders decode as epoch 0 / integrity off
     epoch = struct.unpack_from("<Q", raw, off)[0] if len(raw) >= off + 8 else 0
+    integrity = raw[off + 8] != 0 if len(raw) >= off + 9 else False
     return (task_id, bool(flags & 1), bool(flags & 2), n, r, c, rs, re,
-            col_ranges, epoch)
+            col_ranges, epoch, integrity)
 
 
 def encode_fft1_matrix(task_id, first_row, panel):
@@ -294,6 +305,71 @@ def decode_fft_exchange(raw):
     m = decode_scalar_matrix(raw[40:])
     return (task_id, col_start, col_count, row_start,
             m.reshape(16, row_count, col_count))
+
+
+# --- result-integrity codecs (runtime/integrity.py) --------------------------
+
+def encode_eval_request(point, values):
+    """EVAL: evaluate sum_i values[i] * point^i on the worker."""
+    return (int(point % R_MOD).to_bytes(FR_BYTES, "little")
+            + struct.pack("<Q", len(values)) + encode_scalars(values))
+
+
+def decode_eval_request(raw):
+    point = int.from_bytes(raw[:FR_BYTES], "little")
+    (n,) = struct.unpack_from("<Q", raw, FR_BYTES)
+    off = FR_BYTES + 8
+    return point, decode_scalars(raw[off:off + n * FR_BYTES])
+
+
+def encode_scalar(v):
+    return int(v % R_MOD).to_bytes(FR_BYTES, "little")
+
+
+def decode_scalar(raw):
+    return int.from_bytes(raw[:FR_BYTES], "little")
+
+
+def encode_fft2_request(task_id, point=None):
+    """FFT2 fetch, optionally carrying the integrity check point: when
+    `point` rides the frame the worker piggybacks its (input-side,
+    output-side) partial power sums at that point on the reply. Workers
+    that predate the integrity plane ignore the trailing bytes (the
+    decoder unpacks only the leading u64), so the request stays
+    back-compatible."""
+    head = struct.pack("<Q", task_id)
+    if point is None:
+        return head
+    return head + encode_scalar(point)
+
+
+def decode_fft2_request(raw):
+    (task_id,) = struct.unpack_from("<Q", raw, 0)
+    point = None
+    if len(raw) >= 8 + FR_BYTES:
+        point = decode_scalar(raw[8:8 + FR_BYTES])
+    return task_id, point
+
+
+_FFT2_PARTIAL_FLAG = b"\x01"
+
+
+def encode_fft2_partials(a, b, panel_bytes):
+    """Reply = flag byte + 32B input-side partial + 32B output-side
+    partial + the panel. The panel alone is a multiple of 32 bytes, so
+    receivers distinguish the two layouts by `len % 32 == 1` — a reply
+    from an integrity-unaware worker (panel only) still parses."""
+    return _FFT2_PARTIAL_FLAG + encode_scalar(a) + encode_scalar(b) \
+        + panel_bytes
+
+
+def split_fft2_reply(raw):
+    """((input_partial, output_partial) | None, panel_bytes)."""
+    if len(raw) % FR_BYTES == 1 and raw[:1] == _FFT2_PARTIAL_FLAG:
+        a = decode_scalar(raw[1:1 + FR_BYTES])
+        b = decode_scalar(raw[1 + FR_BYTES:1 + 2 * FR_BYTES])
+        return (a, b), raw[1 + 2 * FR_BYTES:]
+    return None, raw
 
 
 # --- proof service codecs ----------------------------------------------------

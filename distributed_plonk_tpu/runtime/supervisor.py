@@ -161,6 +161,28 @@ class WorkerSupervisor:
             except Exception:
                 pass
 
+    def attach_registry(self, registry):
+        """Close the quarantine loop (runtime/integrity.py): when the
+        membership registry LEAVEs a member with reason="integrity", the
+        process is ALIVE — it answers probes, its answers are wrong — so
+        liveness supervision alone would never replace it. Subscribing
+        here turns the quarantine verdict into a SIGKILL of the owning
+        slot; the normal watch loop then respawns it (backoff + flap-cap
+        rules apply to repeat offenders) and the fresh process re-JOINs
+        through the challenge gate."""
+        def _on_event(ev):
+            if ev.get("event") != "leave" \
+                    or ev.get("reason") != "integrity":
+                return
+            j = self.slot_for_port(ev.get("port"))
+            if j is not None:
+                # kill() waits on the process: never block the
+                # registry's emit path behind it
+                threading.Thread(target=self.kill, args=(j,),
+                                 daemon=True).start()
+        registry.subscribe(_on_event)
+        return self
+
     def add_slot(self, store_dir=None):
         """Grow the supervised fleet by one slot at runtime (scale-up):
         the new worker takes the exact JOIN path of every other member.

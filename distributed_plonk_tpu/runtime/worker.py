@@ -35,10 +35,11 @@ from collections import OrderedDict
 import numpy as np
 
 from . import native, protocol
+from .faults import FaultInjector
 from .netconfig import NetworkConfig
 from ..constants import R_MOD, FR_GENERATOR
 from ..fields import fr_inv, fr_root_of_unity
-from ..poly import Domain
+from ..poly import Domain, poly_eval
 from ..trace import NULL_TRACER, Tracer, msm_flops, ntt_flops
 
 # resident per-trace span buffers: the dispatcher fetches-and-forgets
@@ -64,7 +65,8 @@ class FftTask:
     one slice assignment); `created` supports age-based GC, fixing the
     reference's task leak on dispatcher abort (worker.rs:378)."""
 
-    def __init__(self, inverse, coset, n, r, c, rs, re, col_ranges, me):
+    def __init__(self, inverse, coset, n, r, c, rs, re, col_ranges, me,
+                 keep_raw=False):
         self.inverse = inverse
         self.coset = coset
         self.n, self.r, self.c = n, r, c
@@ -74,6 +76,15 @@ class FftTask:
         self.rows = [None] * (re - rs)     # [local j2] -> length-r row (ints)
         self.rows_mat = None               # (16, re-rs, r) panel (jax path)
         self.rows_filled = np.zeros(re - rs, dtype=bool)
+        # RAW stage-1 input panels as received (first_row -> limbs): the
+        # integrity plane's input-side partial is a power sum of what
+        # this worker actually holds, so the dispatcher can tell "your
+        # input rotted" from "your stage-2 math lied" (keyed by
+        # first_row, so a retried FFT1 resend overwrites idempotently).
+        # Retained only when FFT_INIT announced an armed integrity plane
+        # (keep_raw) — a plane-off fleet keeps legacy panel memory.
+        self.keep_raw = keep_raw
+        self.raw_panels = {}
         # [16, local k1, j2] stage-2 input columns; fill_mask tracks exchange
         # completeness per (column, row) cell — a REGION mask, not a counter,
         # so a retried FFT2_PREPARE (same panels re-pushed after a dispatcher
@@ -100,6 +111,11 @@ class WorkerState:
         # fleet / never joined): FFT_INIT frames planned against an older
         # epoch are rejected as stale, and ROSTER pushes advance it
         self.epoch = epoch
+        # worker-side chaos: the `corrupt:at=data` plane perturbs OUR
+        # computed results before framing (SDC model — runtime/faults.py);
+        # None when DPT_FAULTS is unset, zero-overhead fast path
+        self.faults = FaultInjector.from_env()
+        self.sdc_injected = 0
         self.warm = None  # warm-rejoin stats (store/remote.warm_sync)
         self.started = time.monotonic()
         self.base_sets = {}  # set_id -> bases (a worker can adopt ranges)
@@ -189,6 +205,52 @@ class WorkerState:
                     self.drop_peer(p)
                     if attempt:
                         raise
+
+
+def _sdc_due(state, tag):
+    """True when the worker-side data-plane chaos should corrupt the
+    result just computed for `tag` (see runtime/faults.py, at=data)."""
+    if state.faults is None:
+        return False
+    if not state.faults.on_data(state.me, tag):
+        return False
+    with state.lock:
+        state.sdc_injected += 1
+    return True
+
+
+# sum_j row[j] * base^j — exactly dense-poly Horner evaluation
+_horner = poly_eval
+
+
+def _fft2_partials(task, point):
+    """The integrity piggyback (runtime/integrity.py): (input-side,
+    output-side) partial power sums at the dispatcher's random point.
+    Input side walks the RAW stage-1 rows as received (flat index
+    j1*c + j2 -> row j2 Horner in base t^c, scaled t^j2); output side
+    walks the computed result panel (flat index k1 + r*k2 -> row k1
+    Horner in base t^r, scaled t^k1). Both are computed from the SAME
+    buffers the data plane serves, so an SDC in either shows up in the
+    partials exactly as it does in the data. O(n/k) host muls."""
+    a = 0
+    tc = pow(point, task.c, R_MOD)
+    for first_row, panel in sorted(task.raw_panels.items()):
+        count, row_len = panel.shape[1], panel.shape[2]
+        ints = protocol.matrix_to_ints(panel.reshape(16, count * row_len))
+        tk = pow(point, first_row, R_MOD)
+        for off in range(count):
+            row = ints[off * row_len:(off + 1) * row_len]
+            a = (a + _horner(row, tc) * tk) % R_MOD
+            tk = tk * point % R_MOD
+    b = 0
+    vals = protocol.decode_scalars(task.result)
+    c = task.c
+    tr = pow(point, task.r, R_MOD)
+    tk = pow(point, task.cs, R_MOD)
+    for k1 in range(task.ce - task.cs):
+        b = (b + _horner(vals[k1 * c:(k1 + 1) * c], tr) * tk) % R_MOD
+        tk = tk * point % R_MOD
+    return a, b
 
 
 def _stage1_row(backend, domain_r, task, j2, row):
@@ -329,6 +391,11 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                          flops=msm_flops(len(scalars)),
                          data_bytes=len(scalars) * protocol.FR_BYTES):
             result = state.backend.msm(bases, scalars)
+        if _sdc_due(state, protocol.MSM):
+            # a WELL-FORMED wrong answer (on-curve, in-subgroup): only
+            # value-level checks (duplicate execution) can catch it
+            from .. import curve as _C
+            result = _C.g1_add_affine(result, _C.G1_GEN)
         conn.send(protocol.OK, protocol.encode_point(result))
     elif tag == protocol.NTT:
         values, inverse, coset = protocol.decode_ntt_request(payload)
@@ -345,11 +412,14 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                 out = state.backend.coset_fft(domain, values)
             else:
                 out = state.backend.fft(domain, values)
+        if _sdc_due(state, protocol.NTT):
+            out = list(out)
+            out[0] = (out[0] + 1) % R_MOD  # one flipped field element
         conn.send(protocol.OK,
                   protocol.encode_scalar_matrix(protocol.ints_to_matrix(out)))
     elif tag == protocol.FFT_INIT:
         (task_id, inverse, coset, n, r, c, rs, re,
-         col_ranges, epoch) = protocol.decode_fft_init(payload)
+         col_ranges, epoch, keep_raw) = protocol.decode_fft_init(payload)
         now = time.monotonic()
         with state.lock:
             if epoch and state.epoch and epoch != state.epoch:
@@ -369,13 +439,18 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                 return None
             _evict_fft_tasks(state.fft_tasks, _FFT_TASK_CAP, now)
             state.fft_tasks[task_id] = FftTask(
-                inverse, coset, n, r, c, rs, re, col_ranges, state.me)
+                inverse, coset, n, r, c, rs, re, col_ranges, state.me,
+                keep_raw=keep_raw)
         conn.send(protocol.OK)
     elif tag == protocol.FFT1:
         task_id, first_row, panel = protocol.decode_fft1_matrix(payload)
         with state.lock:
             task = state.fft_tasks[task_id]
         count = panel.shape[1]
+        if task.keep_raw:
+            # retain the raw input panel: the FFT2 integrity piggyback's
+            # input-side partial is computed over exactly what we received
+            task.raw_panels[first_row] = panel
         with tracer.span("fft1_rows", rows=count, r=task.r,
                          flops=ntt_flops(task.r, count),
                          data_bytes=count * task.r * protocol.FR_BYTES):
@@ -460,7 +535,7 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                            row_start:row_start + panel.shape[1]] = True
         conn.send(protocol.OK)
     elif tag == protocol.FFT2:
-        (task_id,) = struct.unpack_from("<Q", payload, 0)
+        task_id, check_point = protocol.decode_fft2_request(payload)
         with state.lock:
             task = state.fft_tasks[task_id]
             domain_c = state.domain(task.c)
@@ -484,8 +559,38 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                     # reply rides the bulk codec (wire-identical path)
                     task.result = protocol.encode_scalar_matrix(
                         protocol.ints_to_matrix(out))
+            if task.result and _sdc_due(state, protocol.FFT2):
+                # SDC in the computed panel: one element perturbed IN the
+                # cached buffer — retries and the integrity partials all
+                # see the same corrupted result, like a real bad chip
+                v = (protocol.decode_scalar(task.result) + 1) % R_MOD
+                task.result = protocol.encode_scalar(v) \
+                    + task.result[protocol.FR_BYTES:]
             task.done_at = time.monotonic()
-        conn.send(protocol.OK, task.result)
+        if check_point is not None and task.result \
+                and (task.keep_raw or task.re <= task.rs):
+            # integrity piggyback: (input-side, output-side) partial
+            # power sums at the dispatcher's random point, computed from
+            # the very buffers the data plane serves (O(n/k) host muls).
+            # A task whose FFT_INIT did not announce the plane (mixed-
+            # version fleet) answers plain — a zero input-side claim
+            # over rows we dropped would read as a false SDC verdict.
+            a, b = _fft2_partials(task, check_point)
+            conn.send(protocol.OK,
+                      protocol.encode_fft2_partials(a, b, task.result))
+        else:
+            conn.send(protocol.OK, task.result)
+    elif tag == protocol.EVAL:
+        # distributed partial evaluation (round 4 of the fleet prove):
+        # sum_i c_i * point^i over the shipped coefficient chunk — the
+        # dispatcher scales by point^start and folds across workers;
+        # duplicate-executed chunks cross-check workers for SDC
+        point, chunk = protocol.decode_eval_request(payload)
+        with tracer.span("eval", n=len(chunk)):
+            val = state.backend.eval_h(state.backend.lift(chunk), point)
+        if _sdc_due(state, protocol.EVAL):
+            val = (val + 1) % R_MOD
+        conn.send(protocol.OK, protocol.encode_scalar(val))
     elif tag == protocol.STATS:
         import json as _json
         with state.lock:
@@ -510,6 +615,10 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                 "now": time.time(),
                 "traces": len(state.traces),
                 "epoch": state.epoch,
+                # result-integrity chaos visibility: how many computed
+                # results this worker's data plane has corrupted (always
+                # 0 outside DPT_FAULTS soaks)
+                "sdc_injected": state.sdc_injected,
                 # warm-rejoin stats (set once after a --join worker
                 # finishes its peer sync): the supervisor/operator's
                 # evidence that a respawn came up warm

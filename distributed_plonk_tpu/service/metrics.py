@@ -151,6 +151,39 @@ Durability vocabulary (service/journal.py + the restart-recovery path):
                                              artifact was evicted (job
                                              re-proved, same bytes)
 
+Result-integrity vocabulary (runtime/integrity.py, runtime/dispatcher.py,
+runtime/health.py, service/pool.py — the SDC defense):
+    integrity_checks                         algebraic phase checks run
+                                             (FFT/NTT Schwartz-Zippel,
+                                             MSM group-law sanity, eval
+                                             dup sampling decisions)
+    integrity_failures                       checks that caught a WRONG
+                                             (well-formed) answer
+    integrity_msm_dups                       MSM ranges duplicate-
+                                             executed on a second worker
+                                             (rate DPT_INTEGRITY_MSM_DUP)
+    integrity_eval_dups                      evaluation chunks duplicate-
+                                             executed (same rate knob)
+    workers_quarantined                      workers marked SUSPECT by an
+                                             attributed integrity failure
+                                             (sticky breaker; LEAVEd when
+                                             membership is armed)
+    integrity_challenges                     known-answer challenge
+                                             proves run against (re-)
+                                             joining quarantined
+                                             addresses
+    integrity_challenges_failed              challenges the worker
+                                             answered WRONG (it stays
+                                             quarantined)
+    self_verify_checks                       verify-before-serve pairing
+                                             checks run (DPT_SELF_VERIFY)
+    self_verify_failures                     finished proofs that failed
+                                             the pairing verifier
+    self_verify_s (histogram)                verify-before-serve latency
+    proofs_blocked                           proofs withheld from the
+                                             journal/client by a failed
+                                             self-verify (job re-proved)
+
 Tracing vocabulary (trace.py, service/pool.py, server.py --obs-port):
     trace_spans_recorded                     spans folded into finished
                                              jobs' merged timelines

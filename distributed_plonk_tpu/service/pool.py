@@ -51,6 +51,14 @@ class JobTimeout(Exception):
     pass
 
 
+class ProofRejected(Exception):
+    """Verify-before-serve failed: the finished proof does not pairing-
+    verify (silent data corruption somewhere between witness and
+    serialization). The proof is BLOCKED — it never reaches a journal
+    DONE record or a client; the checkpoint is cleared so the retry
+    re-proves from scratch (resuming would replay the corrupt state)."""
+
+
 class WorkerDrained(Exception):
     """Graceful drain hit its deadline: the worker stops at the next
     round boundary (snapshot already durable) and the job stays
@@ -180,10 +188,25 @@ class WorkerPool:
     def __init__(self, metrics, prover_workers=2, max_retries=2,
                  job_timeout_s=None, ckpt_dir=None, backend_factory=None,
                  verify_on_complete=False, store=None, faults=None,
-                 journal=None, requeue=None):
+                 journal=None, requeue=None, self_verify=None,
+                 verify_remote=False):
         self.metrics = metrics
         self.max_retries = max_retries
         self.job_timeout_s = job_timeout_s
+        # verify-before-serve (DPT_SELF_VERIFY): "1" verifies EVERY
+        # finished proof with the host pairing verifier before the
+        # journal DONE record / client-visible done; "0" never; "auto"
+        # (default) verifies work that ran on a non-local compute plane
+        # — mesh-placed sharded proves, or any prove when the pool's
+        # backend is a remote fleet (verify_remote=True) — which is
+        # where silent data corruption lives. A failing proof is never
+        # served: it is BLOCKED (proofs_blocked), the checkpoint
+        # dropped, and the job re-proved; with a fleet backend the
+        # integrity plane has meanwhile quarantined the suspect workers,
+        # so the re-prove runs on the survivors.
+        self.self_verify = (os.environ.get("DPT_SELF_VERIFY", "auto")
+                            if self_verify is None else str(self_verify))
+        self.verify_remote = bool(verify_remote)
         # requeue: the admission JobQueue (set by ProofService) — a
         # retried MESH-placed job goes back through the scheduler for
         # RE-PLACEMENT (fresh lease + sharded backend) instead of
@@ -525,7 +548,8 @@ class WorkerPool:
                                                 proofs, errors):
             if proof is not None:
                 try:
-                    self._finish_proved(job, res, ckt, proof, tracer)
+                    self._finish_proved(job, res, ckt, proof, tracer,
+                                        backend=backend)
                     job.attempts.append({"worker": worker.name,
                                          "outcome": "ok"})
                     self.metrics.inc("jobs_completed")
@@ -610,15 +634,14 @@ class WorkerPool:
                          batch_size=job.batch_size)
         return tracer
 
-    def _finish_proved(self, job, res, ckt, proof, tracer):
+    def _finish_proved(self, job, res, ckt, proof, tracer, backend=None):
         """Post-prove completion shared by the single and batched paths:
-        optional server-side verify, round/kernel metrics, finished-proof
-        durability, trace artifact, client-visible done."""
-        if self.verify_on_complete:
-            from ..verifier import verify
-            assert verify(res.vk, ckt.public_input(), proof,
-                          rng=random.Random(1)), \
-                "proof failed server-side verification"
+        verify-before-serve, round/kernel metrics, finished-proof
+        durability, trace artifact, client-visible done. ORDER IS THE
+        CONTRACT: the self-verify gate runs on the serialized bytes
+        BEFORE the journal DONE append, so a corrupted proof can never
+        be journaled as done, served from an artifact after a restart,
+        or handed to a client."""
         totals = tracer.totals(depth=1)
         self.metrics.observe_rounds(totals)
         # kernel spans carry flops attrs (prover.py): fold them into
@@ -627,9 +650,64 @@ class WorkerPool:
         self.metrics.observe_kernels(tracer.events)
         proof_bytes = serialize_proof(proof)
         pub = ckt.public_input()
+        if self.faults is not None and self.faults.on_proof(job.id):
+            # at=proof chaos plane: SDC between prove and serve — flip
+            # one byte so only the verify gate below can catch it
+            mid = len(proof_bytes) // 2
+            proof_bytes = (proof_bytes[:mid]
+                           + bytes([proof_bytes[mid] ^ 0xFF])
+                           + proof_bytes[mid + 1:])
+        if self._should_self_verify(job, backend):
+            self._self_verify(job, res, pub, proof_bytes, tracer)
         self._journal_done(job, proof_bytes, pub)
         self._store_trace(job, tracer)
         job.finish_ok(proof_bytes, pub, totals)
+
+    def _should_self_verify(self, job, backend=None):
+        if self.verify_on_complete:
+            return True
+        mode = self.self_verify
+        if mode in ("0", "off"):
+            return False
+        if mode in ("1", "on", "always"):
+            return True
+        # auto: only the non-local compute planes pay the pairing check —
+        # mesh placements, an operator-declared remote pool, or a prove
+        # that actually ran on a fleet backend (RemoteBackend.name):
+        # fleet-placed work is where SDC lives, and the flag must not
+        # depend on every call site remembering to set verify_remote
+        return (self.verify_remote or job.placement == "mesh"
+                or getattr(backend, "name", "") == "remote")
+
+    def _self_verify(self, job, res, pub, proof_bytes, tracer):
+        """The end-to-end truth oracle, moved into the serving path: the
+        host pairing verifier runs on the SERIALIZED bytes (what would
+        be journaled/served), its verdict and latency land in metrics +
+        the job's trace timeline, and a failure blocks the proof."""
+        from ..proof_io import deserialize_proof
+        from ..verifier import verify
+        w0, p0 = time.time(), time.perf_counter()
+        try:
+            ok = verify(res.vk, pub, deserialize_proof(proof_bytes),
+                        rng=random.Random(1))
+        except Exception:  # undecodable bytes are equally blocked
+            ok = False
+        dur = time.perf_counter() - p0
+        self.metrics.inc("self_verify_checks")
+        self.metrics.observe("self_verify_s", dur)
+        tracer.add_event("service/self_verify", ts=w0, dur_s=dur,
+                         job_id=job.id, ok=ok)
+        if ok:
+            return
+        self.metrics.inc("self_verify_failures")
+        self.metrics.inc("proofs_blocked")
+        # never resume the corrupt state: the retry re-proves fresh
+        # (deterministic bytes — a transient SDC yields a good proof,
+        # a persistent one exhausts retries into a FAILED verdict,
+        # which is still never a wrong answer served)
+        self._clear_ckpt(job)
+        raise ProofRejected(
+            f"proof for job {job.id} failed verify-before-serve")
 
     def _run_attempt(self, worker, backend, job, res):
         if self.job_timeout_s is not None:
@@ -648,7 +726,8 @@ class WorkerPool:
                     # failing identically until retries are exhausted
                     guard.clear()
                 raise
-            self._finish_proved(job, res, ckt, proof, tracer)
+            self._finish_proved(job, res, ckt, proof, tracer,
+                                backend=backend)
         finally:
             worker.deadline = None
 

@@ -43,7 +43,8 @@ class ProofService:
                  finished_retention=4096, allow_remote_shutdown=False,
                  store_dir=None, store_byte_budget=None, bucket_cap=64,
                  store_peers=None, faults=None, journal_dir=None,
-                 devices=None, mesh_backend_factory=None):
+                 devices=None, mesh_backend_factory=None,
+                 self_verify=None, verify_remote=False):
         self.host = host
         self.port = port
         self.chaos = chaos
@@ -86,7 +87,8 @@ class ProofService:
             ckpt_dir=ckpt_dir, backend_factory=backend_factory,
             verify_on_complete=verify_on_complete, store=self.store,
             faults=self.faults, journal=self.journal,
-            requeue=self.queue)
+            requeue=self.queue, self_verify=self_verify,
+            verify_remote=verify_remote)
         # store_peers: [(host, port)] of peers speaking STORE_FETCH — a
         # bucket miss tries a network copy from a warm peer before paying
         # for a full key build (elastic scale-out: a fresh host serves

@@ -14,7 +14,15 @@
 #                          the FULL kernel registry + carry contracts + repo
 #                          lints (python -m distributed_plonk_tpu.analysis,
 #                          ~90 s of pure tracing, nothing executes)
-#   scripts/ci.sh chaos    fault-domain + observability suite: dead-worker
+#   scripts/ci.sh chaos    fault-domain + observability suite, PLUS the
+#                          result-integrity suite (ISSUE 13): injected
+#                          silent data corruption (wrong MSM partial /
+#                          FFT panel / round-4 eval) detected at the
+#                          phase boundary, attributed to the injected
+#                          worker, quarantined (LEAVE -> supervisor
+#                          respawn -> challenge-gated rejoin), proofs
+#                          byte-identical, and DPT_SELF_VERIFY blocking
+#                          corrupt proofs from journal/clients: dead-worker
 #                          sweep over every protocol phase (byte-identical
 #                          proofs), breaker open/re-admission, cross-host
 #                          store-fetch resume, injection layer (~1-2 min,
@@ -43,6 +51,7 @@ fi
 if [ "$1" = "chaos" ]; then
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_runtime_faults.py tests/test_membership.py \
+    tests/test_integrity.py \
     tests/test_service_journal.py \
     tests/test_trace.py tests/test_obs.py tests/test_placement.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly

@@ -85,6 +85,9 @@ def main():
     sup = WorkerSupervisor(args.member_host, mserver.port, n=args.workers,
                            backend=args.backend, store_dirs=store_dirs,
                            metrics=metrics, cwd=REPO).start()
+    # integrity quarantine -> kill the lying (but alive) process so the
+    # respawn re-enters through the challenge-gated JOIN
+    sup.attach_registry(d.membership)
     if faults is not None:
         faults.proc_kill_cb = sup.proc_killer(d)
 

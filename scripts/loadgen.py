@@ -188,6 +188,155 @@ def run_kill_service_soak(args):
     return 0 if ok else 1
 
 
+def run_sdc_soak(args):
+    """--sdc-rate: the result-integrity acceptance soak (ISSUE 13). A
+    supervised 3-worker FLEET serves a mixed job stream through a
+    fleet-backed proof service, with EVERY worker's data plane armed to
+    silently corrupt computed results (`corrupt:at=data:rate=R` in each
+    worker subprocess's DPT_FAULTS — random phases: MSM partials, FFT
+    panels, NTT replies, round-4 eval chunks; random workers). The
+    integrity plane must detect each corruption at its phase boundary,
+    attribute + quarantine the lying worker (supervisor respawn +
+    challenge-gated rejoin), DPT_SELF_VERIFY=1 must block anything that
+    slips through, and EVERY served proof must verify client-side —
+    zero unverified proofs served is the exit-code contract."""
+    from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                          RemoteBackend)
+    from distributed_plonk_tpu.runtime.health import LivenessTracker
+    from distributed_plonk_tpu.runtime.integrity import FleetIntegrity
+    from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+    from distributed_plonk_tpu.runtime.supervisor import WorkerSupervisor
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+    from distributed_plonk_tpu.service.metrics import Metrics
+
+    t0 = time.time()
+    fm = Metrics()  # fleet-side registry: integrity/quarantine counters
+    d = Dispatcher(NetworkConfig([]), metrics=fm,
+                   integrity=FleetIntegrity(
+                       metrics=fm, msm_dup_rate=1.0,
+                       rng=random.Random(args.chaos_seed)))
+    d.tracker = LivenessTracker(0, breaker_k=2, probe_base_s=0.05,
+                                probe_max_s=0.5, metrics=fm)
+    mserver = d.enable_membership()
+    fleet_n = 3
+
+    def spawn_cmd(i, slot):
+        # workers 1..n-1 are corrupt-armed in EVERY incarnation (repeat
+        # offenders cycle quarantine -> respawn -> challenge, into the
+        # flap cap if they keep lying); worker 0 stays clean — the soak
+        # models a fleet with SOME bad chips, not a fleet where every
+        # referee is also lying (all-corrupt is indistinguishable from
+        # no ground truth and correctly ends in FAILED verdicts, which
+        # the backstop test of this soak is not about)
+        cmd = [sys.executable, "-m",
+               "distributed_plonk_tpu.runtime.worker",
+               "--join", f"127.0.0.1:{mserver.port}",
+               "--listen", f"127.0.0.1:{slot.port}",
+               "--backend", "python"]
+        if i > 0:
+            cmd = ["env",
+                   f"DPT_FAULTS=corrupt:at=data:rate={args.sdc_rate}"] \
+                + cmd
+        return cmd
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sup = WorkerSupervisor("127.0.0.1", mserver.port, n=fleet_n,
+                           metrics=fm, cwd=repo,
+                           spawn_cmd=spawn_cmd).start()
+    sup.attach_registry(d.membership)
+    svc = None
+    results = []
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if len(d.workers) == fleet_n \
+                    and len(d.tracker.usable_set()) == fleet_n:
+                break
+            time.sleep(0.1)
+        # fleet-backed service: one pool worker drives the one dispatcher
+        # (verify-before-serve ON — the backstop under the phase checks)
+        svc = ProofService(
+            port=0, prover_workers=1, chaos=True, max_retries=4,
+            allow_remote_shutdown=True, self_verify="1",
+            backend_factory=lambda: RemoteBackend(d, dist_fft_min=64),
+        ).start()
+        key_cache, key_lock = {}, threading.Lock()
+        mix = _job_mix(args)
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            for i in range(args.jobs):
+                spec = dict(mix[i % len(mix)])
+                spec.update(seed=4000 + i)
+                out = {"index": i, "spec": spec}
+                try:
+                    out["job_id"] = c.submit(spec)["job_id"]
+                    st = c.wait(out["job_id"], timeout_s=args.timeout)
+                    out["state"] = st["state"]
+                    out["retries"] = st["retries"]
+                    if st["state"] == "done":
+                        header, blob = c.result(out["job_id"])
+                        out["verified"] = _verify_result(
+                            header, blob, key_cache, key_lock)
+                    else:
+                        out["error"] = st.get("error")
+                except Exception as e:  # noqa: BLE001
+                    out["error"] = repr(e)
+                results.append(out)
+            svc_metrics = c.metrics()
+            c.shutdown_server()
+        # best-effort: each CURRENT incarnation's own injected-SDC count
+        # (corrupt incarnations that were already replaced undercount)
+        sdc_injected = sum((h or {}).get("sdc_injected", 0)
+                           for h in d.health())
+    finally:
+        sup.stop()
+        try:
+            d.shutdown()
+        finally:
+            d.pool.shutdown(wait=False)
+        if svc is not None:
+            svc.shutdown()
+    fc = fm.snapshot()["counters"]
+    sc = svc_metrics["counters"]
+    verified = sum(1 for r in results if r.get("verified"))
+    done = sum(1 for r in results if r.get("state") == "done")
+    # THE contract: everything served verified — and nothing was served
+    # without the self-verify gate having passed it
+    ok = (verified == args.jobs and done == args.jobs)
+    summary = {
+        "mode": "sdc", "ok": ok,
+        "wall_s": round(time.time() - t0, 3),
+        "jobs": args.jobs, "sdc_rate": args.sdc_rate,
+        "verified": verified,
+        "unverified_served": done - verified,
+        "failed": [r for r in results if not r.get("verified")],
+        "detections": {
+            "integrity_checks": fc.get("integrity_checks", 0),
+            "integrity_failures": fc.get("integrity_failures", 0),
+            "msm_dups": fc.get("integrity_msm_dups", 0),
+            "eval_dups": fc.get("integrity_eval_dups", 0),
+            "self_verify_checks": sc.get("self_verify_checks", 0),
+            "self_verify_failures": sc.get("self_verify_failures", 0),
+            "proofs_blocked": sc.get("proofs_blocked", 0),
+            "sdc_injected_live": sdc_injected,
+        },
+        "quarantines": {
+            "workers_quarantined": fc.get("workers_quarantined", 0),
+            "membership_leaves": fc.get("membership_leaves", 0),
+            "worker_respawns": fc.get("worker_respawns", 0),
+            "challenges": fc.get("integrity_challenges", 0),
+            "challenges_failed": fc.get("integrity_challenges_failed", 0),
+            "flap_capped": fc.get("worker_flap_capped", 0),
+        },
+        "reproves": {
+            "job_retries": sc.get("job_retries", 0),
+            "fft_replans": fc.get("fleet_fft_replans", 0),
+            "range_adoptions": fc.get("fleet_range_adoptions", 0),
+        },
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default=None,
@@ -232,12 +381,23 @@ def main():
                          "byte-identical")
     ap.add_argument("--journal-dir", default=None,
                     help="journal dir for --kill-service (default: tmp)")
+    ap.add_argument("--sdc-rate", type=float, default=None, metavar="R",
+                    help="result-integrity soak (ISSUE 13): run the job "
+                         "mix through a supervised 3-worker FLEET whose "
+                         "workers silently corrupt computed results "
+                         "(corrupt:at=data) at this rate — random phases "
+                         "(MSM/FFT/NTT/eval) and workers; the summary "
+                         "reports detections/quarantines/re-proves and "
+                         "the exit code asserts zero unverified proofs "
+                         "served")
     ap.add_argument("--timeout", type=float, default=600)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.kill_service is not None:
         return run_kill_service_soak(args)
+    if args.sdc_rate is not None:
+        return run_sdc_soak(args)
     from distributed_plonk_tpu.runtime.faults import FaultInjector, Rule
     from distributed_plonk_tpu.service import ProofService, ServiceClient
 
