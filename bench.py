@@ -501,9 +501,59 @@ def host_prove_seconds():
     return None, "no host baseline available"
 
 
+def _measure_autotune():
+    """Kernel-calibration pickup + in-run A/B (ISSUE 14): every bench
+    line records where the kernel plan came from (fresh|store|off|none),
+    what the pickup cost, and what the plan is worth vs the knob-free
+    defaults at the bench shape (mont-boundary NTT kernel A/B, both
+    sides measured this run). The plan persists in a bench-local store
+    (bench_artifacts/autotune_store), so the first line on a platform
+    records source=fresh and every later line source=store — the
+    trajectory shows both the calibration cost and its amortization.
+    DPT_AUTOTUNE=off skips everything (pre-autotune dispatch exactly)."""
+    mode = os.environ.get("DPT_AUTOTUNE", "run").strip().lower()
+    out = {"autotune_plan_source": "off", "autotune_s": 0.0}
+    if mode == "off":
+        return out
+    t0 = time.perf_counter()
+    try:
+        from distributed_plonk_tpu.backend import autotune as AT
+        from distributed_plonk_tpu.store import ArtifactStore, calibration
+        store = ArtifactStore(os.environ.get(
+            "DPT_AUTOTUNE_STORE",
+            os.path.join(REPO, "bench_artifacts", "autotune_store")))
+        budget = float(os.environ.get("DPT_AUTOTUNE_BUDGET_S", "180"))
+        rep = calibration.load_or_run(store, mode=mode, shapes=[N],
+                                      budget_s=budget, aot=False)
+        out["autotune_plan_source"] = rep.get("source", "none")
+        plan = AT.active_plan()
+        if plan is not None:
+            tuner = AT.Autotuner([N], budget_s=budget)
+            _, dt_plan, _ = tuner._run_ntt(N)
+            AT.set_active_plan(None)
+            try:
+                _, dt_def, _ = tuner._run_ntt(N)
+            finally:
+                AT.set_active_plan(plan)
+            if dt_plan > 0:
+                out["autotune_speedup_vs_defaults"] = round(
+                    dt_def / dt_plan, 3)
+                out["autotune_ab_basis"] = (
+                    "mont-boundary NTT kernel at the bench shape "
+                    f"2^{LOG_N}: calibrated plan vs knob-free defaults, "
+                    "both measured this run")
+    except Exception as e:  # noqa: BLE001 - calibration is diagnostic;
+        # never fail the bench line
+        out["autotune_error"] = repr(e)
+    out["autotune_s"] = round(time.perf_counter() - t0, 3)
+    return out
+
+
 def inner_main():
     """The actual measurement (runs in a budgeted subprocess)."""
     extra = {}
+    extra.update(_measure_autotune())
+    _partial_put(extra)
     ntt_dev, ntt_batch, nb, ntt_meta = device_ntt_seconds()
     extra.update(ntt_meta)
     extra[f"ntt_2p{LOG_N}_elements_per_s"] = round(N / ntt_dev)
@@ -1172,6 +1222,10 @@ def _degraded(reason, extra=None):
         "vs_baseline": None,
         "degraded": True,
         "degraded_reason": reason,
+        # every line carries the autotune keys; a partial inner run's
+        # real values (restored below) override these placeholders
+        "autotune_plan_source": "off",
+        "autotune_s": 0.0,
         "recorded_prove_2p13_s": _RECORDED_DEVICE["prove_2p13_wall_clock_s"],
         "recorded_prove_2p13_vs_host_oracle":
             _RECORDED_DEVICE["prove_2p13_vs_host_oracle"],

@@ -67,12 +67,11 @@ def _field_entries():
         one = (limb_rows(L, 8),)
         limbs_out = [(0, U16)]
         n = spec.name.lower()
-        for mul_path in (True, False):  # f32/MXU default, u32 reference
-            tag = "f32" if mul_path else "u32"
+        for tag in ("f32", "u32"):  # f32/MXU default, u32 reference
             out.append(Entry(
                 f"field/{n}_mont_mul_{tag}",
                 lambda a, b, s=spec: FJ.mont_mul(s, a, b), pair,
-                limbs_out, patches=[(FJ, "_F32_MUL", mul_path)]))
+                limbs_out, patches=[(FJ, "_MUL_MODE", tag)]))
         out.append(Entry(f"field/{n}_add",
                          lambda a, b, s=spec: FJ.add(s, a, b), pair,
                          limbs_out))

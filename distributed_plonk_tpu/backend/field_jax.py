@@ -29,23 +29,11 @@ import jax.numpy as jnp
 # embed host CPU features, and loading another host's entries fails with
 # "machine feature mismatch" warnings (round-2 weakness) — separate
 # subdirectories make every host build/read only its own entries.
-
-def machine_fingerprint():
-    """Stable 12-hex id of what XLA:CPU AOT entries actually depend on:
-    the architecture + CPU feature flags of this host."""
-    import hashlib
-    import platform
-    cpu = ""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    cpu = line
-                    break
-    except OSError:
-        pass
-    return hashlib.sha256(
-        f"{platform.machine()}|{cpu}".encode()).hexdigest()[:12]
+# machine_fingerprint lives in backend/autotune.py now (the calibration
+# artifact key and the compile-cache partition are ONE machine identity);
+# re-exported here for the existing import sites.
+from .autotune import machine_fingerprint
+from . import autotune
 
 
 def configure_compile_cache(base_dir, min_compile_secs=1.0):
@@ -269,8 +257,23 @@ def _skew_colsum(m, shift, dtype=jnp.uint32):
 #       below the f32 FMA rate; kept as a reference oracle).
 #   pallas: force the Pallas kernel for any wide-enough shape (interpret
 #       mode off-TPU — slow, test-only).
+MUL_CHOICES = ("pallas", "f32", "u32")
 _MUL_MODE = os.environ.get("DPT_FIELD_MUL", "auto")
-_F32_MUL = _MUL_MODE != "u32"
+
+
+def _mul_path(n=None):
+    """Resolved multiplier mode name: the explicit DPT_FIELD_MUL knob
+    (env, or a test-patched _MUL_MODE attr) wins, then the autotune
+    plan's winner ("field", "mul") near n lanes, else "auto" (platform
+    default). Read per call like msm_jax's dispatch knobs."""
+    return autotune.attr_or_plan(_MUL_MODE, "auto", "DPT_FIELD_MUL",
+                                 "field", "mul", n)
+
+
+def _f32_active(n=None):
+    """Whether the XLA byte-product/MXU path (vs the u32 reference
+    oracle) backs non-Pallas mont_muls under the resolved mode."""
+    return _mul_path(n) != "u32"
 
 # below this many lanes the per-call overhead of a pallas kernel exceeds
 # the XLA path's cost (scalar/narrow shapes: transcript scalars, finish
@@ -302,14 +305,15 @@ def pallas_disabled():
 
 
 def _use_pallas(shape):
-    if _MUL_MODE in ("u32", "f32") or getattr(_pallas_off, "v", False):
+    if getattr(_pallas_off, "v", False):
         return False
     lanes = 1
     for d in shape[1:]:
         lanes *= d
-    if lanes < _PALLAS_MIN_LANES:
+    mode = _mul_path(lanes)
+    if mode in ("u32", "f32") or lanes < _PALLAS_MIN_LANES:
         return False
-    if _MUL_MODE == "pallas":
+    if mode == "pallas":
         return True
     return jax.default_backend() == "tpu"
 
@@ -384,7 +388,7 @@ def _mul_columns_u32(a, b, out_limbs):
 
 def _mul_columns(a, b, out_limbs):
     """Carry-free column sums of the product, truncated to out_limbs limbs."""
-    if _F32_MUL:
+    if _f32_active():
         return _mul_columns_f32(a, b, out_limbs)
     return _mul_columns_u32(a, b, out_limbs)
 
@@ -461,7 +465,7 @@ def mont_mul(spec, a, b):
     l = spec.n_limbs
     t_cols = _mul_columns(a, b, 2 * l)  # a*b < p^2, uncarried
     t_lo, c_t = _carry_sweep(t_cols[:l])  # exact t mod R + carry into col l
-    if _F32_MUL:
+    if _f32_active():
         # constant products ride the MXU as banded-Toeplitz matmuls
         m_cols = _mul_columns_const(spec.ninv_toeplitz, t_lo, l)
         m, _ = _carry_sweep(m_cols)  # m = (t mod R)*(-p^-1) mod R

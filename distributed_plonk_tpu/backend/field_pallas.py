@@ -46,7 +46,26 @@ LIMB_MASK = (1 << LIMB_BITS) - 1
 # VMEM — while giving the VPU full rows. DPT_PALLAS_LANE_TILE widens the
 # tile (fewer sequential grid steps at NTT widths — a 2^22-lane stage mul
 # is 8192 steps at 512 — trading VMEM for per-step overhead).
-LANE_TILE = int(os.environ.get("DPT_PALLAS_LANE_TILE", "512"))
+LANE_TILE_DEFAULT = 512
+LANE_TILE = int(os.environ.get("DPT_PALLAS_LANE_TILE",
+                               str(LANE_TILE_DEFAULT)))
+
+
+def lane_tile(n=None):
+    """Per-call lane tile: the env/patched LANE_TILE attr wins, else the
+    autotune plan's winner ("field", "lane_tile") near n lanes, else the
+    built-in 512 (same precedence as ntt_pallas._vmem_mb). A plan value
+    that is not a positive power of two falls back to the default — the
+    tile divides the padded lane count and feeds BlockSpec shapes, so a
+    malformed plan (e.g. 0) must never reach the kernel math."""
+    from . import autotune
+
+    t = int(autotune.attr_or_plan(
+        LANE_TILE, LANE_TILE_DEFAULT, "DPT_PALLAS_LANE_TILE",
+        "field", "lane_tile", n, cast=int))
+    if t != LANE_TILE and (t < 1 or (t & (t - 1))):
+        return LANE_TILE_DEFAULT
+    return t
 
 
 def _const_bytes(value, n_bytes):
@@ -334,9 +353,11 @@ _KERNELS = {"mxu": _mont_mul_kernel_mxu, "lazy": _mont_mul_kernel_lazy,
             "strict": _mont_mul_kernel}
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2))
-def _mont_mul_flat(spec_key, interpret, variant, a, b):
-    """(L, N) x (L, N) -> (L, N), N a multiple of LANE_TILE."""
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _mont_mul_flat(spec_key, interpret, variant, tile, a, b):
+    """(L, N) x (L, N) -> (L, N), N a multiple of `tile` (the resolved
+    lane tile — a static jit arg, so plan-tuned and knob-tuned tiles
+    compile distinct programs instead of sharing one)."""
     from .field_jax import FR, FQ
 
     spec = FR if spec_key == "fr" else FQ
@@ -351,10 +372,10 @@ def _mont_mul_flat(spec_key, interpret, variant, a, b):
     from jax.experimental.pallas import tpu as pltpu
 
     n = a.shape[1]
-    grid = n // LANE_TILE
-    scratch = [pltpu.VMEM((4 * L, LANE_TILE), jnp.float32)]
-    in_specs = [pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
-                pl.BlockSpec((L, LANE_TILE), lambda i: (0, i))]
+    grid = n // tile
+    scratch = [pltpu.VMEM((4 * L, tile), jnp.float32)]
+    in_specs = [pl.BlockSpec((L, tile), lambda i: (0, i)),
+                pl.BlockSpec((L, tile), lambda i: (0, i))]
     operands = [a, b]
     if variant == "mxu":
         # broadcast constant Toeplitz operands: same block every grid step
@@ -368,7 +389,7 @@ def _mont_mul_flat(spec_key, interpret, variant, a, b):
         out_shape=jax.ShapeDtypeStruct((L, n), jnp.uint32),
         grid=(grid,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((L, LANE_TILE), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((L, tile), lambda i: (0, i)),
         scratch_shapes=scratch,
         interpret=interpret,
     )(*operands)
@@ -395,11 +416,13 @@ def mont_mul(spec, a, b):
         lanes *= d
     af = a.reshape(L, lanes)
     bf = b.reshape(L, lanes)
-    pad = (-lanes) % LANE_TILE
+    tile = lane_tile(lanes)
+    pad = (-lanes) % tile
     if pad:
         af = jnp.pad(af, ((0, 0), (0, pad)))
         bf = jnp.pad(bf, ((0, 0), (0, pad)))
-    out = _mont_mul_flat(spec.name.lower(), interpret, _VARIANT, af, bf)
+    out = _mont_mul_flat(spec.name.lower(), interpret, _VARIANT, tile,
+                         af, bf)
     if pad:
         out = out[:, :lanes]
     return out.reshape(shape)

@@ -42,12 +42,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..constants import FQ_MONT_R, Q_MOD, R_MOD, FR_LIMBS, FQ_LIMBS
+from . import autotune
 from . import curve_jax as CJ
 from . import field_jax as FJ
 from .field_jax import FR
 from .limbs import ints_to_limbs, limbs_to_int
 
 SCALAR_BITS = 256
+
+# accepted knob values — the autotuner enumerates its candidate grid
+# from these (and from the C_CHOICES assert below), so the measured
+# space cannot drift from what the dispatch accepts
+BUCKET_UPDATE_CHOICES = ("onehot", "put")
+KERNEL_CHOICES = ("pallas", "xla")
+C_CHOICES = (7, 8)
 
 
 def window_bits(n):
@@ -82,7 +90,7 @@ def _group_size(n):
     (no scatter op) per-ADD plane traffic is G-independent, so wider
     groups only amortize per-step overhead better — bounded by the fold
     work and the plane-budget cap in _group_size_batch."""
-    g = int(os.environ.get("DPT_MSM_GROUP_MAX", "512"))
+    g = _group_max_knob(n)
     if g < 1:
         g = 512
     g = 1 << (g.bit_length() - 1)  # round down to a power of two: the
@@ -110,9 +118,19 @@ _PLANE_BYTES_BUDGET = int(os.environ.get("DPT_MSM_PLANE_MB", "1536")) << 20
 _BUCKET_UPDATE = os.environ.get("DPT_BUCKET_UPDATE", "auto")
 
 
-def _use_onehot_update():
-    if _BUCKET_UPDATE in ("onehot", "put"):
-        return _BUCKET_UPDATE == "onehot"
+def _group_max_knob(n=None):
+    """Per-call group cap: explicit DPT_MSM_GROUP_MAX > autotune plan
+    near n points > 512 (the shared env > plan > default resolver)."""
+    return autotune.env_or_plan("DPT_MSM_GROUP_MAX", "msm", "group_max",
+                                512, n, cast=int)
+
+
+def _use_onehot_update(n=None):
+    mode = autotune.attr_or_plan(_BUCKET_UPDATE, "auto",
+                                 "DPT_BUCKET_UPDATE", "msm",
+                                 "bucket_update", n)
+    if mode in BUCKET_UPDATE_CHOICES:
+        return mode == "onehot"
     return jax.default_backend() == "tpu"
 
 
@@ -125,8 +143,8 @@ def _use_onehot_update():
 _PLANE_PACK = os.environ.get("DPT_PLANE_PACK", "1") != "0"
 
 
-def _use_packed_planes():
-    return _use_onehot_update() and _PLANE_PACK
+def _use_packed_planes(n=None):
+    return _use_onehot_update(n) and _PLANE_PACK
 
 
 # Bucket-accumulation kernel (DPT_MSM_KERNEL):
@@ -145,16 +163,18 @@ def _use_packed_planes():
 _MSM_KERNEL = os.environ.get("DPT_MSM_KERNEL", "auto")
 
 
-def _use_pallas_kernel():
+def _use_pallas_kernel(n=None):
     if getattr(FJ._pallas_off, "v", False):
         return False
-    if _MSM_KERNEL in ("pallas", "xla"):
-        return _MSM_KERNEL == "pallas"
+    mode = autotune.attr_or_plan(_MSM_KERNEL, "auto", "DPT_MSM_KERNEL",
+                                 "msm", "kernel", n)
+    if mode in KERNEL_CHOICES:
+        return mode == "pallas"
     return jax.default_backend() == "tpu"
 
 
-def _kernel_mode():
-    return "pallas" if _use_pallas_kernel() else "xla"
+def _kernel_mode(n=None):
+    return "pallas" if _use_pallas_kernel(n) else "xla"
 
 
 # packed-pair layout shared with field_jax (round 3's packed coset evals
@@ -207,7 +227,7 @@ def _plane_update(planes, vals, ctx):
                  for b, v in zip(planes, vals))
 
 
-def _group_size_batch(n, batch, c, signed=False):
+def _group_size_batch(n, batch, c, signed=False, kernel=None):
     """Group width for a B-poly batched MSM: work-optimal size per
     _group_size, further capped so the plane array (which scales with
     group * B * W * buckets) stays in budget.
@@ -216,11 +236,17 @@ def _group_size_batch(n, batch, c, signed=False):
     the cap is the VMEM lane budget instead: group shrinks so a window
     tile of >= ~8 lanes still fits (wider window tiles mean fewer
     re-reads of the point stream — see msm_pallas's traffic model);
-    per-step overhead no longer rewards huge groups there."""
+    per-step overhead no longer rewards huge groups there.
+
+    kernel: explicit resolved mode ('pallas'|'xla') from the caller —
+    MsmContext passes its context-width resolution so group sizing,
+    the chunk memo key, and the traced branch all agree; None resolves
+    at n (direct/mesh callers, whose traces resolve at the same n)."""
     w = -(-SCALAR_BITS // c)  # ceil: c=7 has 37 windows, not 36
     buckets = 1 << (c - 1) if signed else 1 << c
     g = _group_size(n)
-    if _use_pallas_kernel():
+    if (kernel == "pallas") if kernel is not None \
+            else _use_pallas_kernel(n):
         from . import msm_pallas
         cap = max(8, msm_pallas.plane_lanes_cap(
             buckets, _PLANE_PACK) // 8)
@@ -252,7 +278,7 @@ def _to_scan_m(a, group):
     return a.reshape(M, group, n // group).transpose(2, 1, 0)
 
 
-def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
+def _bucket_scan(ax, ay, ainf, digits, group, n_buckets, kernel=None):
     """Unsigned COMBINED-LANE bucket accumulation (small-window path).
 
     All M digit lanes (M = batch x windows) share the point stream: one
@@ -270,9 +296,13 @@ def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
 
     DPT_MSM_KERNEL=pallas runs the fused VMEM-resident kernel
     (msm_pallas.bucket_scan) — bit-identical planes at the same group
-    width; this scan remains the parity/debug core.
+    width; this scan remains the parity/debug core. `kernel` pins the
+    resolved mode from the caller (MsmContext resolves at its context
+    width so the trace matches its memo key); None resolves here at the
+    local chunk width.
     """
-    if _use_pallas_kernel():
+    if (kernel == "pallas") if kernel is not None \
+            else _use_pallas_kernel(ax.shape[1]):
         from . import msm_pallas
         return msm_pallas.bucket_scan(ax, ay, ainf, digits, group,
                                       n_buckets, packed=_PLANE_PACK)
@@ -302,7 +332,8 @@ def _bucket_scan(ax, ay, ainf, digits, group, n_buckets):
     return _plane_finish(planes)
 
 
-def _bucket_scan_signed(ax, ay, ainf, packed, group, n_buckets=128):
+def _bucket_scan_signed(ax, ay, ainf, packed, group, n_buckets=128,
+                        kernel=None):
     """SIGNED-digit COMBINED-LANE bucket accumulation — the signed hot
     path (c=8: 128 bucket columns; c=7: 64): half the buckets of the
     unsigned scan (bucket i holds points whose |digit| == i+1; the sign
@@ -317,9 +348,11 @@ def _bucket_scan_signed(ax, ay, ainf, packed, group, n_buckets=128):
 
     DPT_MSM_KERNEL=pallas runs the fused VMEM-resident kernel
     (msm_pallas.bucket_scan_signed) — bit-identical planes at the same
-    group width; this scan remains the parity/debug core.
+    group width; this scan remains the parity/debug core. `kernel`: see
+    _bucket_scan.
     """
-    if _use_pallas_kernel():
+    if (kernel == "pallas") if kernel is not None \
+            else _use_pallas_kernel(ax.shape[1]):
         from . import msm_pallas
         return msm_pallas.bucket_scan_signed(ax, ay, ainf, packed, group,
                                              n_buckets,
@@ -450,7 +483,7 @@ def finish(bx, by, bz, signed=False):
     return tuple(v[:, 0] for v in acc)
 
 
-def bucket_planes_batch(ax, ay, ainf, digits, group):
+def bucket_planes_batch(ax, ay, ainf, digits, group, kernel=None):
     """B-polynomial bucket accumulation over SHARED bases: affine points
     (24, nc) + inf mask (nc,) + digits (B, W, nc) -> folded planes
     ((24, B*W, 2^c),)*3.
@@ -462,12 +495,12 @@ def bucket_planes_batch(ax, ay, ainf, digits, group):
     B, W, n = digits.shape
     buckets = 1 << (SCALAR_BITS // W)
     flat = digits.reshape(B * W, n)
-    wb = _bucket_scan(ax, ay, ainf, flat, group, buckets)
+    wb = _bucket_scan(ax, ay, ainf, flat, group, buckets, kernel=kernel)
     planes = tuple(x.transpose(1, 0, 2, 3) for x in wb)  # (G, 24, B*W, buckets)
     return fold_planes(*planes)
 
 
-def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
+def bucket_planes_batch_signed(ax, ay, ainf, packed, group, kernel=None):
     """Signed-digit analog of bucket_planes_batch: affine bases (24, nc) +
     inf mask (nc,) + packed digits (B, W, nc) -> ((24, B*W, 2^(c-1)),)*3.
     The window count W determines c (32 -> c=8, 37 -> c=7)."""
@@ -475,7 +508,7 @@ def bucket_planes_batch_signed(ax, ay, ainf, packed, group):
     c = -(-SCALAR_BITS // W)
     flat = packed.reshape(B * W, n)
     wb = _bucket_scan_signed(ax, ay, ainf, flat, group,
-                             n_buckets=1 << (c - 1))
+                             n_buckets=1 << (c - 1), kernel=kernel)
     planes = tuple(x.transpose(1, 0, 2, 3) for x in wb)
     return fold_planes(*planes)
 
@@ -670,9 +703,11 @@ class MsmContext:
         # enough: DPT_MSM_C picks 8 (32 windows x 128 buckets, planes
         # exactly fill (8, 128) minor tiles) or 7 (37 x 64 — half the
         # plane traffic per step at +16% window-adds; A/B'd on chip,
-        # msm_c7_ab_r05.json). Tiny keys keep the unsigned small-window
+        # msm_c7_ab_r05.json); the autotune plan's winner applies when
+        # the knob is unset. Tiny keys keep the unsigned small-window
         # scan (a 16-bucket c=4 plane is layout-padded 8x otherwise).
-        self.c_batch = MsmContext._C_BATCH if self.padded_n >= 256 else self.c
+        self.c_batch = _c_batch_knob(self.padded_n) \
+            if self.padded_n >= 256 else self.c
         # wide windows run the SIGNED pipeline (half the buckets, sign
         # folded into y); both pipelines take affine bases + inf mask and
         # accumulate with complete projective adds
@@ -727,24 +762,40 @@ class MsmContext:
     # (msm_c7_ab_r05.json) measured 29.8 s vs 31.4 s for c=8 (~5%), same
     # result point, both host-oracle-checked at 2^12
     _C_BATCH = int(os.environ.get("DPT_MSM_C", "7"))
-    assert _C_BATCH in (7, 8), f"DPT_MSM_C must be 7 or 8, got {_C_BATCH}"
+    assert _C_BATCH in C_CHOICES, \
+        f"DPT_MSM_C must be 7 or 8, got {_C_BATCH}"
+
+    def _mode(self):
+        """Resolved bucket kernel for this context's width."""
+        return _kernel_mode(self.padded_n)
+
+    def _chunk_key(self, nc, group):
+        """Chunk-fn/call memo key: resolved mode + the autotune plan
+        revision (autotune.cache_key) — the pallas/xla branch is taken
+        at TRACE time inside the jit, so neither an env/attr flip
+        (bench A/B, tests) nor a mid-process plan reload may reuse the
+        other configuration's executable."""
+        return autotune.cache_key(nc, group, self._mode())
 
     def _chunk_fn(self, nc, group):
-        # keyed on the resolved bucket kernel too: the pallas/xla branch
-        # is taken at TRACE time inside the jit, so an env/attr flip
-        # (bench A/B, tests) must not reuse the other mode's executable
-        key = (nc, group, _kernel_mode())
+        key = self._chunk_key(nc, group)
         if key not in self._chunk_fns:
             fn = bucket_planes_batch_signed if self.signed \
                 else bucket_planes_batch
-            self._chunk_fns[key] = jax.jit(partial(fn, group=group))
+            # kernel pinned to the CONTEXT-width resolution (the memo
+            # key above): a plan whose nearest cell at the chunk width
+            # disagrees must not make the traced branch diverge from
+            # the key, the seeded rate, and the AOT-compiled variant
+            self._chunk_fns[key] = jax.jit(
+                partial(fn, group=group, kernel=self._mode()))
         return self._chunk_fns[key]
 
     def _finish_fn(self, batch):
-        if batch not in self._finish_fns:
-            self._finish_fns[batch] = jax.jit(
+        key = autotune.cache_key(batch)
+        if key not in self._finish_fns:
+            self._finish_fns[key] = jax.jit(
                 partial(finish_batch, batch=batch, signed=self.signed))
-        return self._finish_fns[batch]
+        return self._finish_fns[key]
 
     # adds/s measured from the first fenced chunk call; class-level so every
     # context on the process shares the calibration. Keyed by
@@ -757,13 +808,33 @@ class MsmContext:
 
     def _calib_key(self):
         # the fused kernel's adds/s is far from the XLA scan's: a rate
-        # latched under one kernel must not size the other's chunks
-        return (self._platform, self.signed, self.c_batch, _kernel_mode())
+        # latched under one kernel must not size the other's chunks —
+        # and a plan reload retires latched rates with the revision
+        return autotune.cache_key(self._platform, self.signed,
+                                  self.c_batch, self._mode())
+
+    def _plan_rate(self):
+        """The calibration plan's measured adds/s for keys near this
+        width — but only when this context actually dispatches the
+        kernel the plan measured (an env override to the other kernel
+        must not size chunks from the wrong rate). Seeding the rate
+        from the plan makes chunk shapes deterministic from the FIRST
+        call, so the AOT pass covers them and nothing recompiles at
+        serve time (the PR 3/5 chunk-shape remainder)."""
+        rate = autotune.plan_param("msm", "adds_per_s", self.padded_n)
+        if rate is None:
+            return None
+        planned = autotune.plan_param("msm", "kernel", self.padded_n)
+        if planned is not None and planned != self._mode():
+            return None
+        return float(rate)
 
     def _chunk_lanes(self, B, W):
         """Current per-call point budget (1024-aligned)."""
         budget = self._CALL_ADDS
         rate = MsmContext._measured_adds_per_s.get(self._calib_key())
+        if rate is None:
+            rate = self._plan_rate()
         if rate is not None:
             budget = min(self._CALL_ADDS_MAX, int(rate * self._CALL_TARGET_S))
         return max(1024, (budget // (B * W)) & ~1023)
@@ -780,14 +851,17 @@ class MsmContext:
             chunk = self._chunk_lanes(B, W)
             nc = min(chunk, n - i0)
             g = _group_size_batch(nc, B, -(-SCALAR_BITS // W),
-                                  signed=self.signed)
+                                  signed=self.signed, kernel=self._mode())
             fn = self._chunk_fn(nc, g)
             # calibrate once, on a WARM shape only: a first call's
             # wall-clock is dominated by XLA compilation and would wildly
-            # under-read the device rate
-            warm = self._chunk_calls.get((nc, g, _kernel_mode()), 0) > 0
+            # under-read the device rate. A plan-provided rate makes the
+            # fence unnecessary (and keeps chunk shapes pinned to what
+            # the AOT pass compiled).
+            warm = self._chunk_calls.get(self._chunk_key(nc, g), 0) > 0
             calibrate = (self._calib_key() not in
                          MsmContext._measured_adds_per_s
+                         and self._plan_rate() is None
                          and nc >= 8192 and warm)
             if calibrate:
                 if acc is not None:  # drain queued async work first, or
@@ -804,7 +878,7 @@ class MsmContext:
                 with MsmContext._calib_lock:
                     MsmContext._measured_adds_per_s.setdefault(
                         self._calib_key(), B * W * nc / dt)
-            ck = (nc, g, _kernel_mode())
+            ck = self._chunk_key(nc, g)
             self._chunk_calls[ck] = self._chunk_calls.get(ck, 0) + 1
             acc = part if acc is None else tuple(self._merge_fn(acc, part))
             i0 += nc
@@ -861,7 +935,8 @@ class MsmContext:
         mul_widths = set()
         for B in sorted(set(batch_sizes)):
             nc = min(self._chunk_lanes(B, W), self.padded_n)
-            g = _group_size_batch(nc, B, c, signed=self.signed)
+            g = _group_size_batch(nc, B, c, signed=self.signed,
+                                  kernel=self._mode())
             aot(self._chunk_fn(nc, g),
                 jax.ShapeDtypeStruct((FQ_LIMBS, nc), u32),
                 jax.ShapeDtypeStruct((FQ_LIMBS, nc), u32),
@@ -873,7 +948,7 @@ class MsmContext:
             aot(self._finish_fn(B), *planes)
             aot(self._merge_fn, planes, planes)
             shapes.append({"batch": B, "chunk": nc, "group": g,
-                           "kernel": _kernel_mode()})
+                           "kernel": self._mode()})
             # the XLA scan's RCB15 add stages its products as 5- and
             # 6-pair stacked-lane mont_muls at g * B * W lanes; collect
             # the padded widths the fused multiplier would compile at
@@ -881,15 +956,17 @@ class MsmContext:
                 lanes = pairs * g * B * W
                 if FJ._use_pallas((FQ_LIMBS, lanes)):
                     from . import field_pallas as FP
-                    mul_widths.add(lanes + (-lanes) % FP.LANE_TILE)
-        for Nw in sorted(mul_widths):
+                    tile = FP.lane_tile(lanes)
+                    mul_widths.add((lanes + (-lanes) % tile, tile))
+        for Nw, tile in sorted(mul_widths):
             from . import field_pallas as FP
             spec = jax.ShapeDtypeStruct((FQ_LIMBS, Nw), u32)
             aot(FP._mont_mul_flat, "fq",
-                jax.default_backend() != "tpu", FP._VARIANT, spec, spec)
+                jax.default_backend() != "tpu", FP._VARIANT, tile,
+                spec, spec)
         return {"compiled": compiled, "failed": failed, "shapes": shapes,
-                "kernel": _kernel_mode(),
-                "mul_path_widths": sorted(mul_widths)}
+                "kernel": self._mode(),
+                "mul_path_widths": sorted(w for w, _ in mul_widths)}
 
     def msm(self, scalars):
         """Σ scalars_i * bases_i -> affine point (host ints) or None."""
@@ -979,6 +1056,24 @@ class MsmContext:
             make = lambda s: jnp.asarray(
                 digits_of_scalars(s, self.padded_n, self.c_batch))
         return self._run_batches(scalar_lists, make)
+
+
+def _c_batch_knob(n=None):
+    """Resolved batch window width: explicit DPT_MSM_C (latched into
+    MsmContext._C_BATCH, which its import-time assert already validated
+    against C_CHOICES) > autotune plan near an n-point key > 7. A plan
+    value outside C_CHOICES falls back to the default — a malformed
+    plan must never break dispatch (only explicit knobs may raise)."""
+    if "DPT_MSM_C" in os.environ or MsmContext._C_BATCH != 7:
+        # env-set, or test/harness-patched away from the built-in
+        # default: explicit wins over the plan (attr_or_plan semantics)
+        return MsmContext._C_BATCH
+    p = autotune.plan_param("msm", "c", n)
+    try:
+        c = int(p)
+    except (TypeError, ValueError):
+        return MsmContext._C_BATCH
+    return c if c in C_CHOICES else MsmContext._C_BATCH
 
 
 def _proj_limbs_to_affine(tx, ty, tz):

@@ -55,19 +55,39 @@ from jax import lax
 
 from ..constants import R_MOD, FR_GENERATOR, FR_LIMBS, FR_MONT_R
 from ..fields import fr_inv, fr_root_of_unity
+from . import autotune
 from . import field_jax as FJ
 from .field_jax import FR
 from .limbs import ints_to_limbs, limbs_to_ints
 
+# the values the resolvers below accept — the autotuner enumerates its
+# candidate grid from these, so the measured space cannot drift from
+# what the kernels dispatch on
+RADIX_CHOICES = (2, 4)
+KERNEL_CHOICES = ("pallas", "xla")
 
-def _active_radix(radix=None):
-    """Resolve the stage radix: explicit argument > DPT_NTT_RADIX (2|4,
-    default 4). Read per call — not latched at import — so the radix-2
-    path stays selectable for parity debugging without rebuilding plans
-    (mirrors msm_jax's DPT_BUCKET_UPDATE knob)."""
+
+def _active_radix(radix=None, n=None):
+    """Resolve the stage radix: explicit argument > DPT_NTT_RADIX (2|4)
+    > the active autotune plan's winner near domain size n > 4. Read
+    per call — not latched at import — so the radix-2 path stays
+    selectable for parity debugging without rebuilding plans (mirrors
+    msm_jax's DPT_BUCKET_UPDATE knob)."""
     if radix is None:
-        radix = int(os.environ.get("DPT_NTT_RADIX", "4"))
-    if radix not in (2, 4):
+        env = os.environ.get("DPT_NTT_RADIX")
+        if env is not None:
+            radix = int(env)
+        else:
+            p = autotune.plan_param("ntt", "radix", n)
+            try:
+                radix = int(p)
+            except (TypeError, ValueError):
+                radix = 4
+            if radix not in RADIX_CHOICES:
+                # a malformed plan value falls back to the default —
+                # only explicit knobs (arg/env, below) may raise
+                radix = 4
+    if radix not in RADIX_CHOICES:
         raise ValueError(f"NTT radix must be 2 or 4, got {radix!r}")
     return radix
 
@@ -87,29 +107,37 @@ def _active_radix(radix=None):
 _NTT_KERNEL = os.environ.get("DPT_NTT_KERNEL", "auto")
 
 
-def _use_pallas_kernel():
+def _use_pallas_kernel(n=None):
     if getattr(FJ._pallas_off, "v", False):
         return False
-    if _NTT_KERNEL in ("pallas", "xla"):
-        return _NTT_KERNEL == "pallas"
-    if _NTT_KERNEL != "auto":
+    mode = _NTT_KERNEL
+    if mode == "auto":
+        # a plan winner resolves the auto default; an explicit (env or
+        # test-patched) DPT_NTT_KERNEL above stays the override
+        p = autotune.plan_param("ntt", "kernel", n)
+        if p in KERNEL_CHOICES:
+            mode = p
+    if mode in KERNEL_CHOICES:
+        return mode == "pallas"
+    if mode != "auto":
         raise ValueError(
             f"DPT_NTT_KERNEL must be auto|pallas|xla, got {_NTT_KERNEL!r}")
     return jax.default_backend() == "tpu"
 
 
-def _active_kernel(kernel=None):
-    """Resolve the stage-core kernel: explicit argument > DPT_NTT_KERNEL.
+def _active_kernel(kernel=None, n=None):
+    """Resolve the stage-core kernel: explicit argument > DPT_NTT_KERNEL
+    > the active autotune plan near domain size n > platform default.
     Read per call like _active_radix; the pallas_disabled guard wins
     even over an explicit 'pallas' (same invariant as msm_jax)."""
     if kernel is not None:
-        if kernel not in ("pallas", "xla"):
+        if kernel not in KERNEL_CHOICES:
             raise ValueError(
                 f"NTT kernel must be 'pallas' or 'xla', got {kernel!r}")
         if kernel == "pallas" and getattr(FJ._pallas_off, "v", False):
             return "xla"
         return kernel
-    return "pallas" if _use_pallas_kernel() else "xla"
+    return "pallas" if _use_pallas_kernel(n) else "xla"
 
 
 def _mont_table(xs):
@@ -318,7 +346,8 @@ def run_stages(v, consts):
     The pallas dispatch re-checks the guard at trace time: inside
     pallas_disabled()/pallas_guard the XLA tables (always present) run
     instead — bit-identical either way."""
-    if _use_pallas_kernel() and any(k.startswith("pg") for k in consts):
+    if _use_pallas_kernel(v.shape[2]) and any(k.startswith("pg")
+                                              for k in consts):
         from . import ntt_pallas
         return ntt_pallas.run_groups(v, consts)[:, :, consts["perm"]]
     if "exps4" in consts:
@@ -370,7 +399,7 @@ class NttPlan:
     def _effective_radix(self, radix=None):
         """Active radix for this plan: n <= 2 has no radix-4 stage, so the
         radix-2 body covers it (bit-identical either way)."""
-        radix = _active_radix(radix)
+        radix = _active_radix(radix, n=self.n)
         return radix if self.exps4 is not None else 2
 
     def _effective_kernel(self, kernel=None):
@@ -378,7 +407,7 @@ class NttPlan:
         group schedule, so the XLA body covers it (like radix)."""
         if self.log_n < 2:
             return "xla"
-        return _active_kernel(kernel)
+        return _active_kernel(kernel, n=self.n)
 
     def _pallas_consts(self, inverse):
         """Fused-group twiddle VALUE tables (host numpy, cached per
@@ -386,7 +415,9 @@ class NttPlan:
         from . import ntt_pallas
 
         schedule = ntt_pallas.plan_schedule(self.log_n)
-        key = (inverse, schedule)
+        # revision-keyed like _fns: a plan reload may move the schedule
+        # knobs, and stale twiddle blocks must not outlive it
+        key = autotune.cache_key(inverse, schedule)
         if key not in self._pallas_tabs:
             pow_tab = self.pow_inv if inverse else self.pow_fwd
             self._pallas_tabs[key] = ntt_pallas.group_tables(
@@ -497,11 +528,14 @@ class NttPlan:
         fused-stage twiddle blocks) are passed as traced arguments, not
         baked-in constants, so compiled programs and persistent-cache
         entries stay small. `kernel` overrides DPT_NTT_KERNEL like `radix`
-        overrides DPT_NTT_RADIX; the memo is keyed on the resolved mode.
+        overrides DPT_NTT_RADIX; the memo is keyed on the resolved mode
+        plus the autotune plan revision (autotune.cache_key), so a
+        mid-process plan reload can never serve a stale compiled
+        variant.
         """
         radix = self._effective_radix(radix)
         kmode = self._effective_kernel(kernel)
-        key = (inverse, coset, boundary, radix, kmode)
+        key = autotune.cache_key(inverse, coset, boundary, radix, kmode)
         if key not in self._fns:
             plain = boundary == "plain"
             consts = self._kernel_consts(inverse, coset, radix, kmode)
@@ -533,8 +567,9 @@ class NttPlan:
         kmode = self._effective_kernel(kernel)
         if defer_perm and inverse:
             raise ValueError("defer_perm is forward-only")
-        key = (inverse, coset, "batch_noperm" if defer_perm else "batch",
-               radix, kmode)
+        key = autotune.cache_key(
+            inverse, coset, "batch_noperm" if defer_perm else "batch",
+            radix, kmode)
         if key not in self._fns:
             consts = self._kernel_consts(inverse, coset, radix, kmode)
 
@@ -578,8 +613,8 @@ class NttPlan:
         per producer launch."""
         radix = self._effective_radix(radix)
         kmode = self._effective_kernel(kernel)
-        ck = ("fused", key, inverse, coset, radix, kmode,
-              input_perm, defer_perm)
+        ck = autotune.cache_key("fused", key, inverse, coset, radix, kmode,
+                                input_perm, defer_perm)
         if ck not in self._fns:
             consts = self._kernel_consts(inverse, coset, radix, kmode)
 
@@ -621,14 +656,15 @@ class NttPlan:
                     "batch kernels are Montgomery-boundary only")
             self.kernel_batch(inverse, coset, radix=radix, kernel=kmode,
                               defer_perm=defer_perm)
-            key = (inverse, coset,
-                   "batch_noperm" if defer_perm else "batch", radix, kmode)
+            key = autotune.cache_key(
+                inverse, coset, "batch_noperm" if defer_perm else "batch",
+                radix, kmode)
         elif defer_perm:
             raise ValueError("defer_perm needs batch=True")
         else:
             self.kernel(inverse, coset, boundary=boundary, radix=radix,
                         kernel=kmode)
-            key = (inverse, coset, boundary, radix, kmode)
+            key = autotune.cache_key(inverse, coset, boundary, radix, kmode)
         return self._fns[key]
 
     def aot_compile(self, batch_sizes=(), boundaries=("mont", "plain"),
@@ -667,14 +703,14 @@ class NttPlan:
                 for boundary in boundaries:
                     self.kernel(inverse, coset, boundary=boundary,
                                 radix=radix, kernel=kmode)
-                    fn, consts = self._fns[
-                        (inverse, coset, boundary, radix, kmode)]
+                    fn, consts = self._fns[autotune.cache_key(
+                        inverse, coset, boundary, radix, kmode)]
                     aot(fn, consts, v_spec)
                 for b in batch_sizes:
                     self.kernel_batch(inverse, coset, radix=radix,
                                       kernel=kmode)
-                    fn, consts = self._fns[
-                        (inverse, coset, "batch", radix, kmode)]
+                    fn, consts = self._fns[autotune.cache_key(
+                        inverse, coset, "batch", radix, kmode)]
                     aot(fn, consts,
                         jax.ShapeDtypeStruct((FR_LIMBS, b, self.n),
                                              jnp.uint32))

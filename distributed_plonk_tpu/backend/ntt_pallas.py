@@ -66,6 +66,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import autotune
 from .curve_pallas import _mod_add, _mod_sub, _row0_mask, field_consts
 from .field_pallas import _carry_sweep_val, _cols_to_limbs, _to_bytes_f32
 
@@ -74,29 +75,48 @@ from .field_pallas import _carry_sweep_val, _cols_to_limbs, _to_bytes_f32
 # blocks (2 x 4 B x 16 limbs), the stage twiddle blocks (sum_τ 2^τ ~ one
 # more 16-limb row set), a boundary-scale block, and the (4L, rows, T)
 # f32 multiplier scratch (64 rows x 4 B) -> ~512 B.
-_VMEM_MB = int(os.environ.get("DPT_NTT_PALLAS_VMEM_MB", "6"))
+_VMEM_MB_DEFAULT = 6
+_VMEM_MB = int(os.environ.get("DPT_NTT_PALLAS_VMEM_MB",
+                              str(_VMEM_MB_DEFAULT)))
 _PER_ROW_LANE_BYTES = 512
 
 # group cap: largest fused row count 2^R per HBM round trip (the analog
 # of msm_jax's DPT_MSM_GROUP_MAX plane cap); 64 = radix-64
-_ROWS_CAP = int(os.environ.get("DPT_NTT_PALLAS_ROWS", "64"))
+_ROWS_CAP_DEFAULT = 64
+_ROWS_CAP = int(os.environ.get("DPT_NTT_PALLAS_ROWS",
+                               str(_ROWS_CAP_DEFAULT)))
 
 
-def fused_rows_cap():
+def _vmem_mb(n=None):
+    """Per-call VMEM budget: the env/patched module attr wins, else the
+    autotune plan's winner near domain size n, else the default."""
+    return int(autotune.attr_or_plan(
+        _VMEM_MB, _VMEM_MB_DEFAULT, "DPT_NTT_PALLAS_VMEM_MB",
+        "ntt", "vmem_mb", n, cast=int))
+
+
+def _rows_knob(n=None):
+    """Per-call fused-row cap knob (same precedence as _vmem_mb)."""
+    return int(autotune.attr_or_plan(
+        _ROWS_CAP, _ROWS_CAP_DEFAULT, "DPT_NTT_PALLAS_ROWS",
+        "ntt", "rows", n, cast=int))
+
+
+def fused_rows_cap(n=None):
     """Largest power-of-two fused row count whose working set keeps a
     full 128-lane tile inside the VMEM budget (>= 4 so tiny budgets
     still fuse two stages; capped by the group knob)."""
-    cap = (_VMEM_MB << 20) // (_PER_ROW_LANE_BYTES * 128)
+    cap = (_vmem_mb(n) << 20) // (_PER_ROW_LANE_BYTES * 128)
     cap = 1 << max(2, cap.bit_length() - 1)
-    knob = max(4, _ROWS_CAP)
+    knob = max(4, _rows_knob(n))
     knob = 1 << (knob.bit_length() - 1)
     return min(cap, knob)
 
 
-def _lane_tile(m_cols, rows):
+def _lane_tile(m_cols, rows, n=None):
     """Columns per grid cell: widest power-of-two tile within budget
     (>= 1; 256 lanes is plenty to feed the VPU)."""
-    t = (_VMEM_MB << 20) // (_PER_ROW_LANE_BYTES * rows)
+    t = (_vmem_mb(n) << 20) // (_PER_ROW_LANE_BYTES * rows)
     t = 1 << max(0, t.bit_length() - 1)
     return max(1, min(m_cols, t, 256))
 
@@ -108,7 +128,7 @@ def plan_schedule(log_n):
     core covers those widths — same fallback as radix-4's n <= 2)."""
     if log_n < 2:
         return ()
-    r_max = fused_rows_cap().bit_length() - 1
+    r_max = fused_rows_cap(1 << log_n).bit_length() - 1
     n_groups = -(-log_n // r_max)
     base, extra = divmod(log_n, n_groups)
     sizes = [base + 1] * extra + [base] * (n_groups - extra)
@@ -299,7 +319,7 @@ def _group_call(v, r, tws, pre, post, interpret):
     L, B, n = v.shape
     rows = 1 << r
     m_cols = n // rows
-    tile = _lane_tile(m_cols, rows)
+    tile = _lane_tile(m_cols, rows, n)
     operands = [v.reshape(L, B, rows, m_cols)]
     in_specs = [pl.BlockSpec((L, 1, rows, tile), lambda b, c: (0, b, 0, c))]
     if pre is not None:

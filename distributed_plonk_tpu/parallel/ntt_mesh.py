@@ -39,6 +39,7 @@ from .msm_mesh import _shard_map as _shard_map_compat
 
 from ..constants import R_MOD, FR_GENERATOR, FR_LIMBS
 from ..fields import fr_inv, fr_root_of_unity
+from ..backend import autotune
 from ..backend import field_jax as FJ
 from ..backend.field_jax import FR
 from ..backend import ntt_jax
@@ -109,8 +110,9 @@ class MeshNttPlan:
         per-shard run_stages calls pick up the fused multi-stage kernel
         unchanged, and pallas_guard falls them back to the XLA tables on
         a non-TPU mesh at trace time)."""
-        key = (inverse, coset, boundary, ntt_jax._active_radix(),
-               ntt_jax._active_kernel())
+        key = autotune.cache_key(
+            inverse, coset, boundary, ntt_jax._active_radix(n=self.n),
+            ntt_jax._active_kernel(n=self.n))
         # will the TRACED body actually run pallas? Resolve under the
         # same guard the trace runs under (pallas_guard disables it for
         # a non-TPU mesh), so check_vma below is only relaxed for

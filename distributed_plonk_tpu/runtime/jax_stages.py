@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..backend import autotune
 from ..backend import ntt_jax
 from ..backend import field_jax as FJ
 from ..backend.field_jax import FR
@@ -55,8 +56,9 @@ class StageKernels:
         # table sets carry the fused-stage twiddle blocks alongside the
         # XLA tables, so the fleet panels follow the same dispatch knob
         # as the single-device and mesh paths
-        key = ("plan", size, inverse, ntt_jax._active_radix(),
-               ntt_jax._active_kernel())
+        key = autotune.cache_key(
+            "plan", size, inverse, ntt_jax._active_radix(n=size),
+            ntt_jax._active_kernel(n=size))
         if key not in self._tables:
             plan = ntt_jax.get_plan(size)
             self._tables[key] = {

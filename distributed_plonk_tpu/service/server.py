@@ -106,6 +106,11 @@ class ProofService:
             self.queue, self.pool, self.metrics, buckets=self.buckets,
             max_batch=max_batch, devices=devices,
             mesh_backend_factory=mesh_backend_factory)
+        # kernel-calibration pickup report (store/calibration.py), filled
+        # by start(): {"source": off|none|store|fresh, ...}. Without a
+        # store (or DPT_AUTOTUNE=off) no plan is loaded and every kernel
+        # path keeps the built-in defaults.
+        self.autotune = {"source": "off"}
         self._warm_backend = None
         self._warm_backend_lock = threading.Lock()
         self.jobs = {}
@@ -368,7 +373,24 @@ class ProofService:
 
     def start(self):
         """Start scheduler + listener threads; returns self. With port=0
-        an ephemeral port is chosen and published as `self.port`."""
+        an ephemeral port is chosen and published as `self.port`.
+
+        Kernel-calibration pickup runs FIRST (store/calibration.py,
+        DPT_AUTOTUNE=load|run|off): a calibrated store's plan is adopted
+        before any job can trace a kernel, so a second service start
+        reaches its first proof with zero measurement runs and zero
+        kernel compiles at the calibrated shapes (the plan pins the
+        dispatch, the store-synced persistent compile cache holds the
+        winners' executables)."""
+        if self.store is not None:
+            from ..store import calibration
+            try:
+                self.autotune = calibration.load_or_run(
+                    self.store, metrics=self.metrics)
+            except Exception as e:  # noqa: BLE001 - calibration is an
+                # accelerator: a broken plan/measure pass must never
+                # stop the service from serving with defaults
+                self.autotune = {"source": "error", "error": repr(e)}
         self._recover()
         self.scheduler.start()
         self._listener = native.Listener(self.host, self.port)

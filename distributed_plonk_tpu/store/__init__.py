@@ -4,17 +4,21 @@ The layer between key setup and the serving path that turns every server
 restart and repeat circuit shape into a warm hit (ROADMAP: cold-start is
 the dominant serving cost at scale):
 
-    artifacts.py   content-addressed on-disk store — SHA-256 integrity,
-                   atomic writes, versioned manifest, LRU byte budget
-    keycache.py    SRS/proving-key/verifying-key <-> blob serialization
-                   (encoding/proof_io wire idioms; load == fresh build,
-                   element for element)
-    warmstart.py   store-owned JAX persistent-compile-cache dir + AOT
-                   stage precompilation per shape bucket
+    artifacts.py    content-addressed on-disk store — SHA-256 integrity,
+                    atomic writes, versioned manifest, LRU byte budget
+    keycache.py     SRS/proving-key/verifying-key <-> blob serialization
+                    (encoding/proof_io wire idioms; load == fresh build,
+                    element for element)
+    warmstart.py    store-owned JAX persistent-compile-cache dir + AOT
+                    stage precompilation per shape bucket
+    calibration.py  kernel-autotune plan artifacts (backend/autotune.py
+                    winners keyed by machine fingerprint): load_or_run
+                    is the service/worker startup entry point
 
 Consumers: service.scheduler.BucketCache (memory -> disk -> build tiers),
-the WARMUP wire tag (service/server.py), scripts/warmup.py, bench.py's
-cold-vs-warm service round trip, tests/test_store.py.
+the WARMUP wire tag (service/server.py), scripts/warmup.py +
+scripts/autotune.py, bench.py's cold-vs-warm service round trip,
+tests/test_store.py + tests/test_autotune.py.
 """
 
 from .artifacts import ArtifactStore
@@ -25,6 +29,8 @@ from .keycache import (bucket_store_key, serialize_bucket,
 from .warmstart import (set_jax_cache_env, configure_jax_cache,
                         aot_warmup, warm_spec)
 from .remote import FetchError, fetch_blob, fetch_into
+from .calibration import (plan_store_key, store_plan, load_plan,
+                          load_or_run, parse_shapes)
 
 __all__ = [
     "ArtifactStore", "bucket_store_key", "serialize_bucket",
@@ -33,4 +39,6 @@ __all__ = [
     "trace_store_key", "store_trace", "load_trace",
     "set_jax_cache_env", "configure_jax_cache", "aot_warmup", "warm_spec",
     "FetchError", "fetch_blob", "fetch_into",
+    "plan_store_key", "store_plan", "load_plan", "load_or_run",
+    "parse_shapes",
 ]

@@ -1,0 +1,172 @@
+"""Calibration-plan artifacts: persist/load kernel autotune plans.
+
+The store side of backend/autotune.py: a `KernelPlan` (the measured
+winning kernel configuration for one machine) lives in the content-
+addressed artifact store under `autotune:<machine_fingerprint>`, so it
+
+  - survives restarts like bucket keys (a second service start against
+    a calibrated store reaches first proof with ZERO measurement runs),
+  - warm-syncs to joining fleet workers over the STORE_LIST plane like
+    any other artifact (store/remote.WARM_SYNC_PREFIXES includes
+    `autotune:`), and
+  - stays per-machine: a store shared across heterogeneous hosts holds
+    one plan per fingerprint, and a fingerprint miss means "calibrate
+    (or default)", never "crash" or "apply another chip's winners".
+
+`load_or_run` is the one startup entry point (ProofService.start,
+runtime/worker.py, scripts/autotune.py), driven by DPT_AUTOTUNE:
+
+    off    touch nothing — no store reads, no counters, no plan: every
+           kernel path is exactly the pre-autotune tree
+    load   (default) adopt the store's plan for this fingerprint if one
+           exists; otherwise run with built-in defaults (also exactly
+           the pre-autotune tree — the existence probe uses store.meta,
+           which counts nothing)
+    run    load, and on a miss CALIBRATE (budgeted by
+           DPT_AUTOTUNE_BUDGET_S), persist the plan + the winners' AOT
+           executables, then adopt it
+
+Calibration runs under a store-level fcntl lock (`calibration.lock`,
+same discipline as the manifest lock) so concurrent starters against
+one store measure once: losers block, then load the winner's plan.
+"""
+
+import os
+import time
+
+from ..backend import autotune
+from .artifacts import _FileLock
+
+PLAN_PREFIX = "autotune:"
+
+
+def plan_store_key(fingerprint):
+    return PLAN_PREFIX + fingerprint
+
+
+def calibration_lock(store):
+    """Cross-process advisory lock for calibration runs on `store` (the
+    manifest _FileLock mechanism on a sidecar file)."""
+    return _FileLock(os.path.join(store.root, "calibration.lock"))
+
+
+def store_plan(store, plan, metrics=None):
+    """Persist `plan` as the content-addressed artifact for its
+    fingerprint; returns the digest. Canonical JSON, so an unchanged
+    plan re-stores to the identical blob/digest."""
+    digest = store.put(
+        plan_store_key(plan.fingerprint), plan.to_json_bytes(),
+        meta={"kind": "autotune_plan", "fingerprint": plan.fingerprint,
+              "cells": len(plan.cells)})
+    if metrics is not None:
+        metrics.inc("autotune_plan_stores")
+    return digest
+
+
+def load_plan(store, fingerprint=None):
+    """The store's plan for `fingerprint` (default: this machine), or
+    None — on a plain miss, an unparseable blob, or a plan whose
+    EMBEDDED fingerprint disagrees with the requested one (a foreign or
+    hand-copied artifact must trigger a rebuild, not dispatch another
+    chip's winners). The existence probe is store.meta (counter-free),
+    so a plan-less start changes no metrics."""
+    fp = fingerprint or autotune.machine_fingerprint()
+    key = plan_store_key(fp)
+    if store.meta(key) is None:
+        return None
+    blob = store.get(key)
+    if blob is None:
+        return None
+    plan = autotune.KernelPlan.from_json_bytes(blob)
+    if plan is None or plan.fingerprint != fp:
+        return None
+    return plan
+
+
+def parse_shapes(spec):
+    """'2^10,2^14,16384' -> sorted domain sizes."""
+    out = set()
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "^" in part:
+            base, _, exp = part.partition("^")
+            out.add(int(base) ** int(exp))
+        else:
+            out.add(int(part))
+    return sorted(out)
+
+
+def _default_shapes(store):
+    """Shapes to calibrate at when the caller has none: the explicit
+    DPT_AUTOTUNE_SHAPES knob, else the domain sizes of the store's
+    provisioned shape buckets (a warmed store describes its own
+    workload), else one small default."""
+    env = os.environ.get("DPT_AUTOTUNE_SHAPES")
+    if env:
+        return parse_shapes(env)
+    sizes = set()
+    for key in store.keys():
+        if not key.startswith("bucket:"):
+            continue
+        meta = store.meta(key)
+        if meta and isinstance(meta.get("domain_size"), int):
+            sizes.add(meta["domain_size"])
+    return sorted(sizes) or [1 << 10]
+
+
+def load_or_run(store, mode=None, shapes=None, budget_s=None, metrics=None,
+                aot=True):
+    """Startup plan pickup (see module docstring). Returns a report:
+    {source: off|none|store|fresh, fingerprint, cells, measure_runs,
+    run_s?}; on store/fresh the plan is installed as the process-wide
+    KernelConfig (backend/autotune.set_active_plan)."""
+    mode = (mode or os.environ.get("DPT_AUTOTUNE", "load")).strip().lower()
+    if mode not in ("off", "load", "run"):
+        raise ValueError(f"DPT_AUTOTUNE must be off|load|run, got {mode!r}")
+    if mode == "off":
+        return {"source": "off"}
+    fp = autotune.machine_fingerprint()
+    plan = load_plan(store, fp)
+    if plan is not None:
+        autotune.set_active_plan(plan)
+        if metrics is not None:
+            metrics.inc("autotune_plan_loads")
+            _publish(metrics, "store", plan)
+        return {"source": "store", "fingerprint": fp,
+                "cells": len(plan.cells), "measure_runs": 0}
+    if mode != "run":
+        return {"source": "none", "fingerprint": fp, "measure_runs": 0}
+    t0 = time.monotonic()
+    with calibration_lock(store):
+        # a concurrent starter may have calibrated while we waited on
+        # the lock: measure once per store, everyone else loads
+        plan = load_plan(store, fp)
+        source = "store"
+        measure_runs = 0
+        if plan is None:
+            from ..backend.autotune import Autotuner
+
+            tuner = Autotuner(shapes or _default_shapes(store),
+                              budget_s=budget_s, metrics=metrics)
+            plan = tuner.run(aot=aot)
+            store_plan(store, plan, metrics=metrics)
+            source = "fresh"
+            measure_runs = sum(
+                c.get("candidates", 0) + c.get("parity_rejects", 0)
+                + c.get("errors", 0) for c in plan.cells.values())
+    autotune.set_active_plan(plan)
+    if metrics is not None:
+        if source == "store":
+            metrics.inc("autotune_plan_loads")
+        _publish(metrics, source, plan)
+    return {"source": source, "fingerprint": fp, "cells": len(plan.cells),
+            "measure_runs": measure_runs,
+            "run_s": round(time.monotonic() - t0, 3)}
+
+
+def _publish(metrics, source, plan):
+    metrics.gauge("autotune_plan_source", source)
+    metrics.gauge("autotune_plan_cells", len(plan.cells))
+    metrics.gauge("autotune_plan_revision", autotune.plan_revision())

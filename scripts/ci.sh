@@ -14,6 +14,12 @@
 #                          the FULL kernel registry + carry contracts + repo
 #                          lints (python -m distributed_plonk_tpu.analysis,
 #                          ~90 s of pure tracing, nothing executes)
+#   scripts/ci.sh autotune kernel-autotuner smoke tier (ISSUE 14): plan
+#                          store round-trip, fingerprint-mismatch rebuild,
+#                          parity gate vs a lying candidate, env-override
+#                          precedence, DPT_AUTOTUNE=off parity, service +
+#                          fleet-worker plan pickup — tiny shapes,
+#                          interpret-safe budget (XLA:CPU only)
 #   scripts/ci.sh chaos    fault-domain + observability suite, PLUS the
 #                          result-integrity suite (ISSUE 13): injected
 #                          silent data corruption (wrong MSM partial /
@@ -56,6 +62,11 @@ if [ "$1" = "chaos" ]; then
     tests/test_trace.py tests/test_obs.py tests/test_placement.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
+if [ "$1" = "autotune" ]; then
+  exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_autotune.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+fi
 if [ "$1" = "fast" ]; then
   # the AST lints cost <1 s and catch the jit-cache/promotion/lock bug
   # classes before any compile starts; bounds stay in `analyze` (tracing
@@ -65,6 +76,10 @@ if [ "$1" = "fast" ]; then
   # the chaos subset rides along: it is jax-free (no compiles) and pins
   # the fault-domain acceptance surface before kernel-parity compiles start
   bash scripts/ci.sh chaos || exit 1
+  # the autotune smoke tier rides along too: tiny shapes on XLA:CPU, and
+  # it pins the "off/plan-less = byte-identical dispatch" invariant the
+  # kernel-parity tests below now implicitly rely on
+  bash scripts/ci.sh autotune || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_ntt_jax.py tests/test_ntt_pallas.py \
     tests/test_curve_msm_jax.py \

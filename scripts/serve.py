@@ -169,7 +169,8 @@ def main():
     print(json.dumps({"listening": f"{svc.host}:{svc.port}",
                       "obs": f"{obs.host}:{obs.port}" if obs else None,
                       "workers": args.workers, "chaos": args.chaos,
-                      "store": args.store_dir, "journal": journal_dir}),
+                      "store": args.store_dir, "journal": journal_dir,
+                      "autotune": svc.autotune}),
           flush=True)
     svc.serve_forever()
     if obs is not None:

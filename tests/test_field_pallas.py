@@ -54,8 +54,9 @@ def test_mont_mul_variants_bit_identical(spec_key, mod, mont_r, variant):
     ys[:4] = [mod - 1, 0, mod - 1, mod - 2]
     a = ints_to_limbs(xs, spec.n_limbs)
     b = ints_to_limbs(ys, spec.n_limbs)
-    strict = np.asarray(FP._mont_mul_flat(spec_key, True, "strict", a, b))
-    got = np.asarray(FP._mont_mul_flat(spec_key, True, variant, a, b))
+    strict = np.asarray(FP._mont_mul_flat(spec_key, True, "strict", n,
+                                          a, b))
+    got = np.asarray(FP._mont_mul_flat(spec_key, True, variant, n, a, b))
     assert np.array_equal(strict, got)
     r_inv = pow(mont_r, mod - 2, mod)
     assert limbs_to_ints(got) == [

@@ -62,8 +62,11 @@ def test_mesh_ntt_radix2_core_parity(mesh8, plan256, monkeypatch):
     monkeypatch.setenv("DPT_NTT_RADIX", "2")
     got = plan256.run_ints(values)
     assert got == want
-    assert (False, False, "plain", 2, "xla") in plan256._fns
-    assert (False, False, "plain", 4, "xla") in plan256._fns
+    from distributed_plonk_tpu.backend import autotune
+    assert autotune.cache_key(False, False, "plain", 2, "xla") \
+        in plan256._fns
+    assert autotune.cache_key(False, False, "plain", 4, "xla") \
+        in plan256._fns
 
 
 def test_mesh_ntt_roundtrip_uneven_rc(mesh8):

@@ -60,14 +60,18 @@ def test_radix2_matches_radix4(inverse, coset):
 
 def test_radix_env_knob(monkeypatch):
     """DPT_NTT_RADIX routes kernel construction (the msm_jax
-    DPT_BUCKET_UPDATE pattern): resolved per call, no plan rebuild."""
+    DPT_BUCKET_UPDATE pattern): resolved per call, no plan rebuild.
+    Memo keys go through autotune.cache_key (resolved mode + plan
+    revision)."""
+    from distributed_plonk_tpu.backend import autotune
+
     plan = get_plan(64)
     monkeypatch.setenv("DPT_NTT_RADIX", "2")
     plan.kernel(boundary="plain")
-    assert (False, False, "plain", 2, "xla") in plan._fns
+    assert autotune.cache_key(False, False, "plain", 2, "xla") in plan._fns
     monkeypatch.setenv("DPT_NTT_RADIX", "4")
     plan.kernel(boundary="plain")
-    assert (False, False, "plain", 4, "xla") in plan._fns
+    assert autotune.cache_key(False, False, "plain", 4, "xla") in plan._fns
     monkeypatch.setenv("DPT_NTT_RADIX", "3")
     with pytest.raises(ValueError):
         plan.kernel(boundary="plain")
