@@ -1168,6 +1168,18 @@ def sdc_heal_main():
 
 # --- outer harness (no jax imports past this line) ---------------------------
 
+def _emit_trajectory(out):
+    """Append the normalized schema-1 record for this run's ONE line to
+    bench_artifacts/trajectory.jsonl (scripts/bench_record.py) — the
+    machine-readable history scripts/bench_compare.py gates on. Best
+    effort: trajectory bookkeeping must never fail a bench line."""
+    try:
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import bench_record as BR
+        BR.append(BR.normalize("bench", out), repo=REPO)
+    except Exception:
+        pass
+
 def _probe_device(timeout_s):
     """True iff a fresh interpreter can run one tiny jnp op end to end."""
     try:
@@ -1262,6 +1274,7 @@ def _degraded(reason, extra=None):
                 out[k] = cpu[k]
     if extra:
         out.update(extra)
+    _emit_trajectory(out)
     print(json.dumps(out))
 
 
@@ -1444,6 +1457,7 @@ def main():
     result, err = _run_inner(dict(os.environ), budget)
     if result is not None:
         result.update(svc())
+        _emit_trajectory(result)
         print(json.dumps(result))
     else:
         _degraded(err or "inner measurement failed", extra=svc())

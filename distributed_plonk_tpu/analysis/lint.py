@@ -30,8 +30,8 @@ LOCK01/LOCK02 lock discipline (service/ + store/): a self attribute of
     (fixpoint), so `_delete_locked`-style internals don't
     false-positive.
 
-OBS01 metric glossary (service/ + runtime/ + store/): a metric name
-    recorded via a string-literal `.inc("name")` / `.observe("name")`
+OBS01 metric glossary (service/ + runtime/ + store/ + obs/): a metric
+    name recorded via a string-literal `.inc("name")` / `.observe("name")`
     must be documented in service/metrics.py's module docstring — the
     glossary is the operator's only map from a /metrics line to what
     the code actually counted, and undocumented names rot into
@@ -41,6 +41,14 @@ OBS01 metric glossary (service/ + runtime/ + store/): a metric name
     (Metrics.scoped) also pass when their store_-prefixed form is
     documented. F-string/derived names are out of scope (they are
     families; document the wildcard).
+
+LOG01 structured-log subsystem glossary (same dirs as OBS01): the
+    `subsystem` literal of every structured-log emission
+    (`obs.log.emit("dispatcher", ...)` / `LogBuffer.emit(...)`) must be
+    documented in obs/log.py's module docstring glossary — the
+    subsystem field is how an operator slices the fleet's JSONL logs,
+    and an undocumented (or typo'd) subsystem silently forks the
+    vocabulary. Derived/variable subsystems are out of scope.
 
 Suppression: append `# analysis: ok(<reason>)` to the flagged line (or
 the line above) — deliberate exceptions stay visible and reasoned at
@@ -61,11 +69,14 @@ _PKG = os.path.join(_REPO, "distributed_plonk_tpu")
 KERNEL_DIRS = ("backend", "parallel", "runtime")
 # modules with cross-thread shared state: the lock lint runs here
 # (runtime/ added with the fleet fault domain: LivenessTracker state,
-# WorkerState task tables, peer-connection caches are all cross-thread)
-LOCK_DIRS = ("service", "store", "runtime")
+# WorkerState task tables, peer-connection caches are all cross-thread;
+# obs/ added with the fleet observability plane: the log ring and the
+# scraper's latest-snapshot state are cross-thread too)
+LOCK_DIRS = ("service", "store", "runtime", "obs")
 # modules that record metrics into the shared registry: the OBS01
-# glossary lint runs here
-OBS_DIRS = ("service", "store", "runtime")
+# glossary lint runs here; LOG01 (structured-log subsystem glossary)
+# shares the same scope
+OBS_DIRS = ("service", "store", "runtime", "obs")
 
 # mutating container-method names treated as writes by LOCK01 (calls on
 # self.<attr>.<name>(...)); read-only or thread-safe APIs (queue.put,
@@ -514,6 +525,49 @@ def _lint_obs(tree, path, src, findings, glossary):
             "`family_*` wildcard) so the /metrics line stays legible"))
 
 
+# --- LOG01: structured-log subsystem glossary ---------------------------------
+
+_LOG_GLOSSARY_PATH = os.path.join(_PKG, "obs", "log.py")
+
+
+def parse_log_glossary(doc):
+    """Documented subsystem names from a glossary docstring — delegates
+    to obs/log.py's canonical parser (stdlib-only import), so the
+    vocabulary this lint enforces and log.documented_subsystems() are
+    the product of ONE parser."""
+    from ..obs.log import parse_subsystem_glossary
+    return parse_subsystem_glossary(doc)
+
+
+def _load_log_glossary():
+    with open(_LOG_GLOSSARY_PATH) as f:
+        tree = ast.parse(f.read(), filename=_LOG_GLOSSARY_PATH)
+    return parse_log_glossary(ast.get_docstring(tree) or "")
+
+
+def _lint_log_subsystems(tree, path, src, findings, subsystems):
+    pragmas = _pragma_lines(src)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else None)
+        if name != "emit":
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        sub = node.args[0].value
+        if sub in subsystems or _suppressed(pragmas, node.lineno):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "LOG01",
+            f"log subsystem {sub!r} is emitted here but absent from the "
+            "obs/log.py subsystem glossary — document it so the fleet's "
+            "structured logs keep one vocabulary"))
+
+
 # --- driver -------------------------------------------------------------------
 
 def _module_globals(tree):
@@ -549,6 +603,7 @@ def run_lints(pkg_root=_PKG):
     findings = []
     seen = set()
     glossary = _load_glossary()
+    log_glossary = _load_log_glossary()
     for path in _iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS + OBS_DIRS):
         if path in seen:
             continue
@@ -566,14 +621,16 @@ def run_lints(pkg_root=_PKG):
             _lint_locks(tree, path, src, findings)
         if top in OBS_DIRS:
             _lint_obs(tree, path, src, findings, glossary)
+            _lint_log_subsystems(tree, path, src, findings, log_glossary)
     return findings
 
 
 def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
-                glossary_doc=None):
+                glossary_doc=None, log_glossary_doc=None):
     """Lint one source string (unit tests / editor integration).
     glossary_doc: docstring text for the "obs" kind (defaults to the
-    real service/metrics.py glossary)."""
+    real service/metrics.py glossary); log_glossary_doc likewise for
+    the "log" kind (defaults to the real obs/log.py glossary)."""
     findings = []
     tree = ast.parse(src, filename=path)
     if "jit" in kinds:
@@ -586,4 +643,8 @@ def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
         glossary = parse_glossary(glossary_doc) \
             if glossary_doc is not None else _load_glossary()
         _lint_obs(tree, path, src, findings, glossary)
+    if "log" in kinds:
+        subsystems = parse_log_glossary(log_glossary_doc) \
+            if log_glossary_doc is not None else _load_log_glossary()
+        _lint_log_subsystems(tree, path, src, findings, subsystems)
     return findings

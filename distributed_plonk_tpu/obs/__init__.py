@@ -1,0 +1,30 @@
+"""Fleet observability plane (ISSUE 15): one pane of glass.
+
+Three layers, composed from the surfaces the earlier PRs created:
+
+    obs/log.py        structured JSONL event log: level + subsystem +
+                      trace/job/worker correlation, per-process ring
+                      buffer served over the LOG_FETCH wire tag, optional
+                      file sink (serve.py --log-dir / DPT_LOG_DIR) — every
+                      quarantine, replan, respawn, and shed verdict
+                      becomes a queryable event on the same timeline as
+                      the trace spans.
+    obs/fleet.py      fleet metrics aggregation: scrape every worker's
+                      full Metrics snapshot over METRICS_FETCH
+                      (membership-driven, breaker/suspect-aware), render
+                      dpt_fleet_* Prometheus series with per-worker
+                      labels, and build the /fleet JSON snapshot.
+    obs/profiling.py  on-demand capture behind the PROFILE wire tag:
+                      jax.profiler xplane capture on jax backends, an
+                      all-thread Python stack sampler otherwise; captures
+                      land as content-addressed profile:<id> artifacts
+                      served at /profile/<id>.
+
+The wire plane (protocol.METRICS_FETCH/LOG_FETCH/PROFILE) is flag-safe
+and back-compatible like TRACE_DUMP: an old worker answers ERR and the
+caller degrades to an empty result — observability never fails a prove.
+"""
+
+from . import fleet, log, profiling  # noqa: F401
+
+__all__ = ["log", "fleet", "profiling"]

@@ -1,0 +1,244 @@
+"""Structured logging: JSONL events, trace-correlated, ring-buffered.
+
+The queryable upgrade of "it printed something somewhere": every
+noteworthy control-plane decision (a quarantine, an FFT replan, a
+supervisor respawn, a shed verdict) is recorded as ONE structured event
+
+    {"ts": <wall s>, "seq": n, "level": "warn", "subsystem": "dispatcher",
+     "event": "quarantine", "proc": "...", "pid": ...,
+     "trace_id": ..., "job_id": ..., "worker": ..., <fields>}
+
+into a bounded per-process ring buffer. Workers serve their ring over the
+LOG_FETCH wire tag (reads do not clear it — the cap bounds memory, and
+`since_seq` gives tail-f semantics), the dispatcher merges trace-filtered
+events into the per-job `trace:<job_id>` timeline artifact
+(Dispatcher.collect_trace), and a daemon that owns its process can tee
+every event to a JSONL file sink (`serve.py --log-dir` / DPT_LOG_DIR).
+
+Correlation is the point: an event recorded while a traced request is
+being served carries that request's trace_id, so `grep trace_id` across
+the fleet's logs — or the merged timeline's `logs` list — reconstructs
+one incident end to end.
+
+SUBSYSTEM GLOSSARY — every `subsystem=` literal the code emits must be
+documented here; analysis/lint.py's LOG01 lint enforces it (same contract
+as the OBS01 metric glossary). The name column ends at the first run of
+two or more spaces:
+
+    dispatcher   fleet client decisions: quarantines, MSM range
+                 adoptions, FFT replans/degradations, re-admissions
+    membership   roster changes: joins, rejoins, leaves, challenge
+                 verdicts, roster pushes that failed
+    supervisor   worker-process lifecycle: respawns, wedge kills,
+                 flap-cap giveups
+    integrity    result-integrity verdicts: failed phase checks,
+                 duplicate-execution mismatches, challenge outcomes
+    service      serving-plane verdicts: shed/rejected jobs, retries,
+                 self-verify blocks, drain outcomes
+    worker       worker-daemon lifecycle: serve start, warm-rejoin
+                 report, profile captures, injected SDC (chaos)
+    obs          the observability plane itself: scrape errors,
+                 profile-capture failures, log-sink errors
+
+Levels: debug < info < warn < error (no filtering on record — the ring
+is small and the consumer filters; the FILE sink honors DPT_LOG_LEVEL).
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+_LEVELS = {"debug": 0, "info": 1, "warn": 2, "error": 3}
+
+# ring capacity per process (events, not bytes); the ring is the wire-
+# served surface, so the cap is also the LOG_FETCH reply bound
+_CAP = int(os.environ.get("DPT_LOG_CAP", "512"))
+
+
+class LogBuffer:
+    """Bounded ring of structured events + optional JSONL file sink.
+
+    Thread-safe; `seq` is a monotonically increasing per-process event
+    number (fetchers use it for tail-f semantics and to detect drops:
+    `seq - len(events)` events have scrolled out of the ring)."""
+
+    def __init__(self, cap=None, proc=None):
+        self.cap = cap or _CAP
+        self.proc = proc or "main"
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=self.cap)
+        self.seq = 0
+        self._file = None
+        self._file_level = _LEVELS["debug"]
+        self.metrics = None  # duck-typed Metrics; set via set_metrics
+
+    # -- configuration --------------------------------------------------------
+
+    def set_metrics(self, metrics):
+        """Publish log_events/log_dropped counters into a registry."""
+        with self._lock:
+            self.metrics = metrics
+
+    def open_sink(self, log_dir, proc=None, level=None):
+        """Tee every event (at or above `level`) to
+        <log_dir>/<proc>-<pid>.jsonl — line-buffered append, one JSON
+        object per line. Never raises: a broken sink only loses the file
+        copy, the ring keeps serving."""
+        if proc:
+            self.proc = proc
+        level = level or os.environ.get("DPT_LOG_LEVEL", "debug")
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            path = os.path.join(log_dir,
+                                f"{self.proc.replace('/', '_')}-"
+                                f"{os.getpid()}.jsonl")
+            f = open(path, "a", buffering=1)
+        except OSError:
+            return None
+        with self._lock:
+            self._file = f
+            self._file_level = _LEVELS.get(level, 0)
+        return path
+
+    def close_sink(self):
+        with self._lock:
+            f, self._file = self._file, None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    # -- record / read --------------------------------------------------------
+
+    def emit(self, subsystem, event, level="info", trace_id=None,
+             job_id=None, worker=None, **fields):
+        """Record one structured event; returns its seq number."""
+        ev = {"ts": round(time.time(), 6), "level": level,
+              "subsystem": subsystem, "event": event, "proc": self.proc,
+              "pid": os.getpid()}
+        if trace_id is not None:
+            ev["trace_id"] = trace_id
+        if job_id is not None:
+            ev["job_id"] = job_id
+        if worker is not None:
+            ev["worker"] = worker
+        for k, v in fields.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self.seq += 1
+            ev["seq"] = self.seq
+            if len(self._ring) == self.cap and self.metrics is not None:
+                self.metrics.inc("log_dropped")
+            self._ring.append(ev)
+            f = self._file if _LEVELS.get(level, 0) >= self._file_level \
+                else None
+            if f is not None:
+                try:
+                    f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+                except (OSError, ValueError):
+                    self._file = None  # dead sink: ring keeps serving
+        if self.metrics is not None:
+            self.metrics.inc("log_events")
+        return ev["seq"]
+
+    def fetch(self, trace_id=None, since_seq=0, limit=None):
+        """{"events": [...], "seq": latest}: the ring's current contents
+        (oldest first), optionally filtered to one trace id and/or to
+        events after `since_seq`. Reads never clear the ring — fetch is
+        idempotent, the cap bounds memory."""
+        with self._lock:
+            events = list(self._ring)
+            seq = self.seq
+        if since_seq:
+            events = [e for e in events if e["seq"] > since_seq]
+        if trace_id is not None:
+            events = [e for e in events if e.get("trace_id") == trace_id]
+        if limit is not None:
+            events = events[-int(limit):]
+        return {"events": events, "seq": seq}
+
+    def reset(self):
+        """Drop everything (tests)."""
+        with self._lock:
+            self._ring.clear()
+            self.seq = 0
+
+
+# -- per-process default buffer ------------------------------------------------
+# One ring per process is the model: the worker daemon, the serve.py
+# frontend, and an embedded dispatcher each log into their process's
+# buffer; LOG_FETCH serves the worker ones, the service/dispatcher merge
+# their own directly.
+
+_BUFFER = LogBuffer()
+
+
+def buffer():
+    return _BUFFER
+
+
+def emit(subsystem, event, **kw):
+    """Module-level shorthand: obs.log.emit("dispatcher", "quarantine",
+    level="warn", worker=i, reason=...). The LOG01 lint checks the
+    subsystem literal against the glossary above."""
+    return _BUFFER.emit(subsystem, event, **kw)
+
+
+def fetch(trace_id=None, since_seq=0, limit=None):
+    return _BUFFER.fetch(trace_id=trace_id, since_seq=since_seq,
+                         limit=limit)
+
+
+def set_metrics(metrics):
+    _BUFFER.set_metrics(metrics)
+
+
+def configure(log_dir=None, proc=None, metrics=None):
+    """Process-level setup (daemon entry points): name the process, open
+    the file sink, attach a metrics registry. Returns the sink path (or
+    None)."""
+    if proc:
+        _BUFFER.proc = proc
+    if metrics is not None:
+        _BUFFER.set_metrics(metrics)
+    if log_dir:
+        return _BUFFER.open_sink(log_dir, proc=proc)
+    return None
+
+
+def configure_from_env(proc=None):
+    """Honor DPT_LOG_DIR in processes that don't parse flags (workers
+    spawned by the supervisor inherit the env)."""
+    d = os.environ.get("DPT_LOG_DIR")
+    return configure(log_dir=d, proc=proc) if d else configure(proc=proc)
+
+
+def reset():
+    _BUFFER.reset()
+    _BUFFER.close_sink()
+
+
+def parse_subsystem_glossary(doc):
+    """Documented subsystem names from a glossary docstring: the name
+    column (first token, >= 2 spaces before the description) of each
+    indented entry line — prose can't accidentally document one. THE
+    canonical parser: the LOG01 lint (analysis/lint.py) imports this,
+    so the enforced vocabulary and documented_subsystems() cannot
+    diverge."""
+    import re
+    out = set()
+    for line in (doc or "").splitlines():
+        if not line.startswith("    ") or not line.strip():
+            continue
+        cols = re.split(r"\s{2,}", line.strip(), maxsplit=1)
+        if len(cols) == 2 and re.fullmatch(r"[a-z][a-z0-9_]*", cols[0]):
+            out.add(cols[0])
+    return out
+
+
+def documented_subsystems():
+    return parse_subsystem_glossary(__doc__)

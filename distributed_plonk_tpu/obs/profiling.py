@@ -1,0 +1,125 @@
+"""On-demand profile capture (the PROFILE wire tag's engine).
+
+Two capture formats, chosen by what the process can actually do:
+
+    xplane-targz   jax.profiler device trace: start_trace/stop_trace
+                   around the window, the resulting log dir tar-gzipped
+                   into one blob (open in tensorboard/xprof — the
+                   XLA-level view under the kernel spans the trace
+                   timeline already shows).
+    pystacks-json  all-thread Python stack sampler (jax-free workers,
+                   or a platform where the profiler refuses): every
+                   DPT_PROFILE_HZ (default 100) Hz tick grabs
+                   sys._current_frames() and accumulates collapsed
+                   stacks — a poor-man's py-spy that sees every
+                   connection thread's kernel execution, not just the
+                   caller's.
+
+`capture()` never raises: a failed capture returns a degraded-but-valid
+({"format": "error", ...}, b"") pair, because observability must never
+kill the serving thread that armed it.
+
+Captures are content-addressed by blob digest: `profile_id(blob)` is the
+store key suffix (`profile:<id>`, store/keycache.py), so identical
+captures dedupe and the /profile/<id> URL is tamper-evident.
+"""
+
+import hashlib
+import io
+import json
+import os
+import sys
+import tarfile
+import tempfile
+import threading
+import time
+
+_DEFAULT_MS = int(os.environ.get("DPT_PROFILE_MS", "250"))
+_SAMPLE_HZ = float(os.environ.get("DPT_PROFILE_HZ", "100"))
+_MAX_MS = 60_000  # a scraper typo must not arm a minute-long capture
+
+
+def profile_id(blob):
+    """Content id for one capture blob (16 hex chars)."""
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def capture(duration_ms=None, kind="auto", backend_name=None):
+    """(meta dict, blob bytes) for one profile window. kind: "auto"
+    (jax when the backend is jax, else stacks), "jax", or "stacks"."""
+    ms = min(int(duration_ms or _DEFAULT_MS), _MAX_MS)
+    want_jax = kind == "jax" or (kind == "auto" and backend_name == "jax")
+    if want_jax:
+        meta, blob = _capture_jax(ms)
+        if meta is not None:
+            return meta, blob
+        # fall through: the sampler is the universal fallback
+    return _capture_stacks(ms)
+
+
+def _capture_jax(ms):
+    """jax.profiler window -> tar.gz of the trace dir, or (None, b"")
+    when the profiler is unavailable (caller falls back to stacks)."""
+    try:
+        import jax
+    except Exception:
+        return None, b""
+    tmp = tempfile.mkdtemp(prefix="dpt-profile-")
+    try:
+        try:
+            jax.profiler.start_trace(tmp)
+        except Exception:
+            return None, b""
+        time.sleep(ms / 1000.0)  # analysis: ok(host-only ms->s)
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            # a failed stop may leave the session armed — one cleanup
+            # retry so a later capture's start_trace doesn't hit
+            # "profiler already started" and silently downgrade forever
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            return None, b""
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            tf.add(tmp, arcname="profile")
+        blob = buf.getvalue()
+        return {"format": "xplane-targz", "duration_ms": ms,
+                "bytes": len(blob)}, blob
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _capture_stacks(ms):
+    """All-thread stack sampler: collapsed stacks -> JSON blob."""
+    stacks = {}
+    samples = 0
+    me = threading.get_ident()
+    interval = 1.0 / max(_SAMPLE_HZ, 1.0)
+    deadline = time.perf_counter() + ms / 1000.0  # analysis: ok(host-only ms->s)
+    while time.perf_counter() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the sampler's own loop is noise
+            parts = []
+            depth = 0
+            while frame is not None and depth < 64:
+                code = frame.f_code
+                parts.append(f"{os.path.basename(code.co_filename)}:"
+                             f"{code.co_name}:{frame.f_lineno}")
+                frame = frame.f_back
+                depth += 1
+            key = ";".join(reversed(parts))
+            stacks[key] = stacks.get(key, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    blob = json.dumps(
+        {"format": "pystacks-json", "duration_ms": ms,
+         "sample_hz": _SAMPLE_HZ, "samples": samples,
+         "stacks": dict(sorted(stacks.items(), key=lambda kv: -kv[1]))},
+        separators=(",", ":")).encode()
+    return {"format": "pystacks-json", "duration_ms": ms,
+            "samples": samples, "bytes": len(blob)}, blob

@@ -40,6 +40,7 @@ from .integrity import FleetIntegrity, IntegrityError, power_sum
 from .. import curve as C
 from ..backend.python_backend import PythonBackend
 from ..constants import R_MOD
+from ..obs import log as olog
 from ..trace import merge_traces
 
 # worker-side base-set id reserved for known-answer challenges: range ids
@@ -323,6 +324,15 @@ class Dispatcher:
                          tracer=self.tracer))
         return i
 
+    def _log(self, event, level="info", **fields):
+        """One structured log event (obs/log.py) under the dispatcher
+        subsystem, trace-correlated when a tracer is armed — every
+        quarantine/adoption/replan becomes a queryable line on the same
+        timeline as the spans."""
+        olog.emit("dispatcher", event, level=level,
+                  trace_id=self.tracer.trace_id
+                  if self.tracer is not None else None, **fields)
+
     def ping(self):
         for w in self.workers:
             w.call(protocol.PING)
@@ -376,6 +386,7 @@ class Dispatcher:
                 continue
             w.drop_conn()  # stale pre-death stream, if any
             self.tracker.record_ok(i)  # counts fleet_readmissions
+            self._log("readmitted", worker=i)
             self._reprovision(i)
 
     def _reprovision(self, i):
@@ -673,6 +684,8 @@ class Dispatcher:
             self._adopted[dead_i] = j
             self._unprovisioned.discard(dead_i)  # freshly pushed to j
             self.metrics.inc("fleet_range_adoptions")
+            self._log("range_adopted", level="warn", range=dead_i,
+                      worker=j)
             return protocol.decode_point(raw), j
 
         rotation = [(dead_i + off) % k for off in range(1, k + 1)]
@@ -721,6 +734,7 @@ class Dispatcher:
         known-answer challenge (run_challenge)."""
         flipped = self.tracker.mark_suspect(i)
         self.workers[i].drop_conn()
+        self._log("quarantine", level="warn", worker=i, reason=reason)
         if self.tracer is not None:
             self.tracer.add_event("integrity/quarantine", time.time(), 0.0,
                                   worker=i, reason=reason)
@@ -776,6 +790,8 @@ class Dispatcher:
         ok = got_ntt == want_ntt and got_msm == want_msm
         if not ok:
             self.metrics.inc("integrity_challenges_failed")
+        olog.emit("integrity", "challenge", level="info" if ok else "warn",
+                  host=host, port=port, ok=ok)
         return ok
 
     # -- NTT ------------------------------------------------------------------
@@ -966,6 +982,8 @@ class Dispatcher:
                         # path is healthy and must not read as continuous
                         # degradation
                         self.metrics.inc("fleet_fft_degraded")
+                        self._log("fft_degraded", level="warn", n=n,
+                                  active=len(active), width=k)
                     return self.ntt(values, inverse, coset)
                 try:
                     return self._fft_dist_attempt(values, inverse, coset,
@@ -1001,6 +1019,8 @@ class Dispatcher:
                     else:
                         same_set_retry = False
                     self.metrics.inc("fleet_fft_replans")
+                    self._log("fft_replan", level="warn", n=n,
+                              error=repr(last_err)[:200])
         raise RuntimeError(
             f"sharded FFT failed after {k + 1} replans") from last_err
 
@@ -1149,30 +1169,144 @@ class Dispatcher:
                 offsets[i] = snap["now"] - (t0 + t1) / 2.0  # analysis: ok(host-only clock math)
         return offsets
 
-    def collect_trace(self):
+    def collect_trace(self, logs=True):
         """Stitch the distributed timeline for this dispatcher's trace:
         our own spans + every worker's TRACE_DUMP for the trace id,
         timestamps corrected by the per-worker clock-offset estimate.
         Returns the merged dump (trace.merge_traces shape — store it as
         a `trace:<job_id>` artifact via store.keycache.store_trace, or
         export with trace.to_chrome_trace). None when no tracer armed.
-        Worker dumps are fetch-and-forget: collect once, at prove end."""
+        Worker dumps are fetch-and-forget: collect once, at prove end.
+
+        With logs=True the merged dump additionally carries a `logs`
+        list: structured log events (obs/log.py) from THIS process's
+        ring and every worker's LOG_FETCH, either tagged with the trace
+        id or — for subsystems that cannot know it, like a supervisor
+        respawn — untagged events inside the prove's time window, which
+        are stamped with the trace id as they are attributed to it. The
+        chrome export renders them as instant events on the timeline."""
         if self.tracer is None:
             return None
         dumps = [self.tracer.dump()]
         offsets = [0.0]
         est = self.estimate_offsets()
         req = protocol.encode_json({"trace_id": self.tracer.trace_id})
+        log_sets = []  # (events, offset)
         for i, w in enumerate(self.workers):
             try:
                 d = protocol.decode_json(
                     w.call(protocol.TRACE_DUMP, req, traced=False))
             except Exception:
-                continue  # dead/restarted worker: its spans are lost
+                d = {}  # dead/restarted worker: its spans are lost
             if d.get("events"):
                 dumps.append(d)
                 offsets.append(est[i])
-        return merge_traces(dumps, offsets=offsets)
+            if logs:
+                try:
+                    lf = protocol.decode_json(w.call(
+                        protocol.LOG_FETCH, protocol.encode_json({}),
+                        traced=False))
+                    log_sets.append((lf.get("events") or [], est[i]))
+                except Exception:
+                    pass  # old worker / dead: logs degrade to absent
+        merged = merge_traces(dumps, offsets=offsets)
+        if logs:
+            log_sets.append((olog.fetch()["events"], 0.0))
+            merged["logs"] = self._trace_logs(merged, log_sets)
+        return merged
+
+    def _trace_logs(self, merged, log_sets):
+        """Select + offset-correct the log events belonging to one merged
+        timeline: events carrying the trace id always; untagged events
+        whose (corrected) timestamp lies inside the span window too —
+        stamped with the id, since merging IS the attribution."""
+        tid = merged.get("trace_id")
+        events = merged.get("events") or []
+        lo = min((e["ts"] for e in events), default=0.0) - 2  # analysis: ok(host-only window pad)
+        hi = max((e["ts"] + e.get("dur_s", 0.0) for e in events),
+                 default=0.0) + 2  # analysis: ok(host-only window pad)
+        out = []
+        for evs, off in log_sets:
+            for e in evs:
+                e = dict(e)
+                e["ts"] = round(float(e.get("ts", 0.0)) - off, 6)
+                if e.get("trace_id") == tid:
+                    out.append(e)
+                elif "trace_id" not in e and lo <= e["ts"] <= hi:
+                    e["trace_id"] = tid
+                    out.append(e)
+        out.sort(key=lambda e: e["ts"])
+        return out
+
+    # -- fleet observability (obs/fleet.py consumes these) --------------------
+
+    def fleet_metrics(self):
+        """One METRICS_FETCH scrape over the current roster — see
+        obs.fleet.scrape for the entry shape (breaker/suspect-aware;
+        old workers degrade to snapshot=None)."""
+        from ..obs import fleet as obs_fleet
+        return obs_fleet.scrape(self)
+
+    def fetch_logs(self, worker=None, trace_id=None, since_seq=0):
+        """[{worker, events, seq}] from each (or one) worker's LOG_FETCH
+        ring. A worker that predates the tag, or is dead, contributes an
+        empty list — never an error."""
+        req = protocol.encode_json(
+            {k: v for k, v in (("trace_id", trace_id),
+                               ("since_seq", since_seq)) if v})
+        targets = (enumerate(self.workers) if worker is None
+                   else [(worker, self.workers[worker])])
+        out = []
+        for i, w in targets:
+            entry = {"worker": i, "events": [], "seq": 0}
+            try:
+                lf = protocol.decode_json(
+                    w.call(protocol.LOG_FETCH, req, traced=False))
+                entry["events"] = lf.get("events") or []
+                entry["seq"] = lf.get("seq", 0)
+            except Exception:
+                pass
+            out.append(entry)
+        return out
+
+    def profile_worker(self, i, duration_ms=None, kind="auto"):
+        """Arm one on-demand profile capture on worker i (PROFILE tag).
+        Returns (meta, blob); raises on an unreachable worker, returns
+        ({"format": "unsupported", ...}, b"") against an old one. With a
+        tracer armed the capture lands as a span on the timeline so the
+        stored profile:<id> artifact is linked from the trace.
+
+        The capture rides a DEDICATED connection (fresh dial, closed
+        after): the cached WorkerHandle stream serializes frames under
+        its call lock, so a multi-second capture window there would
+        stall every prove RPC to that worker — exactly the harm
+        observability must never cause. Worker-side, the capture blocks
+        only this dedicated connection's thread."""
+        t0 = time.time()
+        w = self.workers[i]
+        h = WorkerHandle(w.host, w.port, index=i, metrics=self.metrics)
+        try:
+            raw = h.call(
+                protocol.PROFILE,
+                protocol.encode_json(
+                    {"duration_ms": duration_ms, "kind": kind}),
+                traced=False)
+        except RuntimeError as e:
+            # ERR reply: a worker that predates the tag — degrade, the
+            # caller still gets a well-formed (meta, blob) pair
+            return {"format": "unsupported", "worker": i,
+                    "error": str(e)[:200]}, b""
+        finally:
+            h.close()
+        meta, blob = protocol.decode_result(raw)
+        if self.tracer is not None:
+            from ..obs import profiling as obs_profiling
+            self.tracer.add_event(
+                "obs/profile", t0, time.time() - t0, worker=i,
+                format=meta.get("format"),
+                profile_id=obs_profiling.profile_id(blob)
+                if blob else None)
+        return meta, blob
 
     # -- misc -----------------------------------------------------------------
 

@@ -45,6 +45,7 @@ import time
 
 from . import native, protocol
 from .health import NullMetrics
+from ..obs import log as olog
 
 
 class MembershipRegistry:
@@ -257,10 +258,20 @@ class MembershipRegistry:
         self.metrics.gauge("membership_epoch", self.epoch)
 
     def _emit(self, span, event):
+        attrs = {k: v for k, v in event.items()
+                 if k != "event" and isinstance(v, (int, float, str, bool))}
+        kind = event.get("event", "change")
+        # every roster change is a structured log event too (obs/log.py):
+        # trace-correlated when the dispatcher's tracer is armed, so the
+        # merged per-job timeline shows the membership churn it survived
+        olog.emit("membership", kind,
+                  level="warn" if kind in ("leave", "challenge_failed")
+                  else "info",
+                  trace_id=self.tracer.trace_id
+                  if self.tracer is not None else None, **attrs)
         if self.tracer is not None:
-            attrs = {k: v for k, v in event.items()
-                     if isinstance(v, (int, float, str, bool))}
-            self.tracer.add_event(span, time.time(), 0.0, **attrs)
+            self.tracer.add_event(span, time.time(), 0.0, event=kind,
+                                  **attrs)
         for fn in list(self._listeners):
             try:
                 fn(event)

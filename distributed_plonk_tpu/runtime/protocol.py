@@ -102,6 +102,31 @@ EVAL = 32          # 32B point, u64 count, count * 32B coeffs -> reply 32B
                    # distributed round-4 evaluation chunk (the dispatcher
                    # scales by point^start and folds; duplicate-executed
                    # chunks cross-check workers against each other)
+# --- fleet observability plane (obs/) ----------------------------------------
+# Flag-safe, back-compatible like TRACE_DUMP: an old worker answers any of
+# these with ERR "unknown tag" and the connection stays usable — scrapers
+# degrade to an empty result, a prove is never harmed.
+METRICS_FETCH = 33  # empty payload -> OK + JSON: the worker's FULL
+                    # service.metrics.Metrics snapshot (counters/gauges/
+                    # histograms incl. per-kernel gflops/MFU gauges) plus
+                    # identity fields (index, epoch, backend, uptime_s,
+                    # sdc_injected) — what the dispatcher/service fleet
+                    # scraper aggregates into dpt_fleet_* series
+LOG_FETCH = 34      # JSON {trace_id?, since_seq?, limit?} -> OK + JSON
+                    # {events: [...], seq}: the worker's structured-log
+                    # ring buffer (obs/log.py), optionally filtered to one
+                    # trace id — how quarantines/replans/respawns become
+                    # queryable events on the merged per-job timeline.
+                    # Reads do NOT clear the ring (idempotent; the cap
+                    # bounds memory), so since_seq gives tail -f semantics.
+PROFILE = 35        # JSON {duration_ms?, kind?} -> OK + [u32 hdr][hdr JSON
+                    # {format, ...}][blob]: arm an on-demand device/host
+                    # profile capture on the worker for the window — the
+                    # jax.profiler xplane capture (format "xplane-targz")
+                    # on jax backends, an all-thread Python stack sampler
+                    # (format "pystacks-json") otherwise. The caller stores
+                    # the blob as a content-addressed profile:<id> artifact
+                    # served at /profile/<id>.
 OK = 100
 ERR = 101
 

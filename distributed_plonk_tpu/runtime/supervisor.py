@@ -48,6 +48,7 @@ import time
 from . import membership
 from .dispatcher import WorkerHandle
 from .health import NullMetrics
+from ..obs import log as olog
 
 
 def _env_ms(name, default):
@@ -270,6 +271,10 @@ class WorkerSupervisor:
             self.metrics.inc("worker_respawns")
             with self._lock:
                 slot.respawns += 1
+            olog.emit("supervisor", "respawn", level="warn", slot=i,
+                      port=slot.port, respawns=slot.respawns)
+        else:
+            olog.emit("supervisor", "spawn", slot=i, port=slot.port)
         self.metrics.gauge("supervised_workers", len(self.slots))
 
     def _schedule_respawn(self, i):
@@ -297,6 +302,8 @@ class WorkerSupervisor:
             # network call outside the lock: a slow membership server
             # must not stall supervision of the other slots
             self.metrics.inc("worker_flap_capped")
+            olog.emit("supervisor", "flap_capped", level="error", slot=i,
+                      port=slot.port)
             membership.leave_fleet(self.join_host, self.join_port,
                                    self.host, slot.port)
 
@@ -344,6 +351,8 @@ class WorkerSupervisor:
                     slot.backoff = 0.0  # stable again: forgive the past
                 wedged = False
         if wedged:
+            olog.emit("supervisor", "wedge_kill", level="warn", slot=i,
+                      port=p)
             self.kill(i)
             self._schedule_respawn(i)
 

@@ -39,7 +39,10 @@ from .faults import FaultInjector
 from .netconfig import NetworkConfig
 from ..constants import R_MOD, FR_GENERATOR
 from ..fields import fr_inv, fr_root_of_unity
+from ..obs import log as olog
+from ..obs import profiling
 from ..poly import Domain, poly_eval
+from ..service.metrics import Metrics
 from ..trace import NULL_TRACER, Tracer, msm_flops, ntt_flops
 
 # resident per-trace span buffers: the dispatcher fetches-and-forgets
@@ -117,6 +120,13 @@ class WorkerState:
         self.faults = FaultInjector.from_env()
         self.sdc_injected = 0
         self.warm = None  # warm-rejoin stats (store/remote.warm_sync)
+        # full observability registry (served counters, kernel latency
+        # histograms, live gflops/MFU gauges) served over METRICS_FETCH —
+        # the structured upgrade of the raw {tag: count} STATS dict,
+        # which stays for wire back-compat. The structured-log ring
+        # (obs/log.py) publishes its counters here too.
+        self.metrics = Metrics()
+        olog.set_metrics(self.metrics)
         self.started = time.monotonic()
         self.base_sets = {}  # set_id -> bases (a worker can adopt ranges)
         self.lock = threading.Lock()
@@ -144,6 +154,21 @@ class WorkerState:
     def count(self, tag):
         with self.lock:
             self.counters[tag] = self.counters.get(tag, 0) + 1
+        # served_<tag> counter family in the structured registry: what
+        # the fleet scraper aggregates into dpt_fleet_served_* series
+        self.metrics.inc("served_" + protocol.tag_name(tag).lower())
+
+    def observe_kernel(self, stage, dur_s, flops=0, data_bytes=0):
+        """Fold one kernel execution into the live per-stage surfaces:
+        a latency histogram plus — when the flops model applies — the
+        same kernel_<stage>_gflops / mfu_<stage>_pct gauges the service
+        pool derives from trace spans, so a fleet worker's device
+        utilization is scrapeable without a trace being armed."""
+        self.metrics.observe(f"worker_{stage}_s", dur_s)
+        if flops:
+            self.metrics.observe_kernels(
+                [{"span": stage, "flops": flops, "dur_s": dur_s,
+                  "data_bytes": data_bytes}])
 
     def tracer_for(self, ctx):
         """The per-trace Tracer an incoming traced frame records under
@@ -216,8 +241,15 @@ def _sdc_due(state, tag):
         return False
     with state.lock:
         state.sdc_injected += 1
+    olog.emit("worker", "sdc_injected", level="warn", worker=state.me,
+              tag=protocol.tag_name(tag))
     return True
 
+
+# traced kernel tags that earn a per-request structured log event (the
+# control/bulk tags — PING, FFT1 panels, exchanges — would only be noise)
+_LOGGED_TAGS = frozenset((protocol.MSM, protocol.NTT, protocol.FFT2,
+                          protocol.EVAL, protocol.FFT_INIT))
 
 # sum_j row[j] * base^j — exactly dense-poly Horner evaluation
 _horner = poly_eval
@@ -315,7 +347,18 @@ def handle(conn, state):
             with tracer.span("serve/" + protocol.tag_name(tag).lower(),
                              parent=parent, req_bytes=len(payload)):
                 cont = _dispatch(conn, state, tag, payload, tracer=tracer)
+            if ctx is not None and tag in _LOGGED_TAGS:
+                # trace-correlated structured event per traced KERNEL
+                # frame (debug level; the ring cap bounds it): the
+                # worker's leg of the incident timeline — LOG_FETCH
+                # filtered by this trace_id returns exactly these
+                olog.emit("worker", "served", level="debug",
+                          worker=state.me, trace_id=tracer.trace_id,
+                          tag=protocol.tag_name(tag))
         except Exception as e:  # malformed payload / backend failure
+            # counted so the fleet scrape's serve-error aggregate
+            # (dpt_fleet_serve_errors_total) reflects real error replies
+            state.metrics.inc("serve_errors")
             try:
                 conn.send(protocol.ERR, repr(e).encode())
             except ConnectionError:
@@ -387,10 +430,14 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
         # kernel span attrs carry the bench.py flops/bytes model so the
         # merged timeline (and the MFU gauges fed from it) can attribute
         # where device time went, not just that it went
+        t0 = time.perf_counter()
         with tracer.span("msm", n=len(scalars),
                          flops=msm_flops(len(scalars)),
                          data_bytes=len(scalars) * protocol.FR_BYTES):
             result = state.backend.msm(bases, scalars)
+        state.observe_kernel("msm", time.perf_counter() - t0,
+                             flops=msm_flops(len(scalars)),
+                             data_bytes=len(scalars) * protocol.FR_BYTES)
         if _sdc_due(state, protocol.MSM):
             # a WELL-FORMED wrong answer (on-curve, in-subgroup): only
             # value-level checks (duplicate execution) can catch it
@@ -401,6 +448,7 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
         values, inverse, coset = protocol.decode_ntt_request(payload)
         with state.lock:
             domain = state.domain(len(values))
+        t0 = time.perf_counter()
         with tracer.span("ntt", n=len(values), inverse=inverse, coset=coset,
                          flops=ntt_flops(len(values)),
                          data_bytes=len(values) * protocol.FR_BYTES):
@@ -412,6 +460,9 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                 out = state.backend.coset_fft(domain, values)
             else:
                 out = state.backend.fft(domain, values)
+        state.observe_kernel("ntt", time.perf_counter() - t0,
+                             flops=ntt_flops(len(values)),
+                             data_bytes=len(values) * protocol.FR_BYTES)
         if _sdc_due(state, protocol.NTT):
             out = list(out)
             out[0] = (out[0] + 1) % R_MOD  # one flipped field element
@@ -451,6 +502,7 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
             # retain the raw input panel: the FFT2 integrity piggyback's
             # input-side partial is computed over exactly what we received
             task.raw_panels[first_row] = panel
+        t0 = time.perf_counter()
         with tracer.span("fft1_rows", rows=count, r=task.r,
                          flops=ntt_flops(task.r, count),
                          data_bytes=count * task.r * protocol.FR_BYTES):
@@ -474,6 +526,8 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                     task.rows[j2 - task.rs] = _stage1_row(
                         state.backend, domain_r, task, j2,
                         ints[off * row_len:(off + 1) * row_len])
+        state.observe_kernel("fft1", time.perf_counter() - t0,
+                             flops=ntt_flops(task.r, count))
         conn.send(protocol.OK)
     elif tag == protocol.FFT2_PREPARE:
         (task_id,) = struct.unpack_from("<Q", payload, 0)
@@ -543,6 +597,7 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
             assert task.fill_mask.all(), \
                 f"fft2 before exchange complete ({task.fill_mask.sum()}" \
                 f"/{task.fill_mask.size})"
+            t0 = time.perf_counter()
             with tracer.span("fft2_cols", cols=task.ce - task.cs, c=task.c,
                              flops=ntt_flops(task.c, task.ce - task.cs)):
                 if state.stages is not None and task.ce > task.cs:
@@ -559,6 +614,9 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
                     # reply rides the bulk codec (wire-identical path)
                     task.result = protocol.encode_scalar_matrix(
                         protocol.ints_to_matrix(out))
+            state.observe_kernel("fft2", time.perf_counter() - t0,
+                                 flops=ntt_flops(task.c,
+                                                 task.ce - task.cs))
             if task.result and _sdc_due(state, protocol.FFT2):
                 # SDC in the computed panel: one element perturbed IN the
                 # cached buffer — retries and the integrity partials all
@@ -652,6 +710,53 @@ def _dispatch(conn, state, tag, payload, tracer=NULL_TRACER):
         store_remote.serve_list(
             state.store, payload, conn,
             no_store_reason="no store on this worker (--store)")
+    elif tag == protocol.METRICS_FETCH:
+        # the fleet-scrape surface (obs/fleet.py): this worker's FULL
+        # structured registry — served counters, kernel latency
+        # histograms, live gflops/MFU gauges — plus identity fields, one
+        # JSON blob. Old dispatchers never send this; old workers answer
+        # ERR "unknown tag" and the scraper degrades to snapshot=None.
+        import json as _json
+        snap = state.metrics.snapshot()
+        with state.lock:
+            snap.update({
+                "index": state.me,
+                "epoch": state.epoch,
+                "backend": getattr(state.backend, "name", "?"),
+                "uptime_s": round(time.monotonic() - state.started, 3),
+                "sdc_injected": state.sdc_injected,
+                "fft_tasks": len(state.fft_tasks),
+                "base_sets": len(state.base_sets),
+                "traces": len(state.traces),
+                "log_seq": olog.buffer().seq,
+            })
+        conn.send(protocol.OK, _json.dumps(snap).encode())
+    elif tag == protocol.LOG_FETCH:
+        # structured-log ring fetch (obs/log.py): optionally filtered to
+        # one trace id (the dispatcher's collect_trace merge) or tailed
+        # via since_seq (the console). Reads never clear the ring.
+        import json as _json
+        req = protocol.decode_json(payload)
+        out = olog.fetch(trace_id=req.get("trace_id"),
+                         since_seq=int(req.get("since_seq") or 0),
+                         limit=req.get("limit"))
+        conn.send(protocol.OK, _json.dumps(out).encode())
+    elif tag == protocol.PROFILE:
+        # on-demand capture (obs/profiling.py): jax.profiler xplane on
+        # jax backends, all-thread Python stack sampler otherwise. The
+        # capture blocks only THIS connection thread for the window —
+        # kernel serving on other connections continues (and is exactly
+        # what the sampler sees). Reply is header+blob like STORE_FETCH.
+        req = protocol.decode_json(payload)
+        meta, blob = profiling.capture(
+            duration_ms=req.get("duration_ms"),
+            kind=req.get("kind", "auto"),
+            backend_name=getattr(state.backend, "name", None))
+        meta["worker"] = state.me
+        state.metrics.inc("profiles_captured")
+        olog.emit("worker", "profile_captured", worker=state.me,
+                  format=meta.get("format"), bytes=len(blob))
+        conn.send(protocol.OK, protocol.encode_result(meta, blob))
     elif tag == protocol.TRACE_DUMP:
         # fetch-and-forget one trace's worker-side spans: the dispatcher
         # stitches them (offset-corrected) into the merged per-job
@@ -740,8 +845,11 @@ def serve(index, config, backend_name="python", ready_event=None,
     # this worker with zero jaxcache:* entries to serve warm-rejoiners
     store = _make_store(store_dir)
     _load_calibration(store)
+    olog.configure_from_env(proc=f"worker/{index}")
     state = WorkerState(_make_backend(backend_name), config=config, me=index,
                         store=store)
+    olog.emit("worker", "serving", worker=index, backend=backend_name,
+              port=port, store=store_dir is not None)
     _run_server(listener, state, ready_event=ready_event)
 
 
@@ -769,10 +877,13 @@ def serve_joined(join_addr, listen_addr=("127.0.0.1", 0),
     # still calibrates)
     store = _make_store(store_dir)
     _load_calibration(store, mode="load")
+    olog.configure_from_env(proc=f"worker/{reply['index']}")
     state = WorkerState(_make_backend(backend_name),
                         config=NetworkConfig(reply["workers"]),
                         me=int(reply["index"]), store=store,
                         epoch=int(reply["epoch"]))
+    olog.emit("worker", "joined", worker=state.me, backend=backend_name,
+              port=port, epoch=state.epoch)
 
     def warm_sync():
         from ..store import remote as store_remote
@@ -792,6 +903,9 @@ def serve_joined(join_addr, listen_addr=("127.0.0.1", 0),
             if _autotune.active_plan() is None:
                 _load_calibration(store)
         state.warm = stats
+        olog.emit("worker", "warm_rejoin", worker=state.me, **{
+            k: v for k, v in stats.items()
+            if isinstance(v, (int, float, str, bool))})
         if store is not None:
             # storeless joiners have nothing to sync: reporting ready
             # would count a zero-length "warm rejoin" and fill the

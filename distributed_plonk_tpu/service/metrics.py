@@ -220,6 +220,49 @@ Tracing vocabulary (trace.py, service/pool.py, server.py --obs-port):
                                              and model-flops MFU from
                                              kernel span attrs (peak set
                                              by DPT_PEAK_TFLOPS)
+
+Fleet observability vocabulary (obs/log.py, obs/fleet.py,
+runtime/worker.py METRICS_FETCH/LOG_FETCH/PROFILE — the one-pane plane,
+ISSUE 15):
+    served_*                                 worker-side request counters
+                                             per wire tag (served_msm,
+                                             served_fft2, ...): the
+                                             structured twin of the raw
+                                             STATS dict, scrapeable over
+                                             METRICS_FETCH
+    worker_*_s (histograms)                  worker-side kernel latency
+                                             per stage (worker_msm_s,
+                                             worker_ntt_s, worker_fft1_s,
+                                             worker_fft2_s)
+    serve_errors                             worker request frames that
+                                             drew an ERR reply (malformed
+                                             payload / backend failure)
+    log_events                               structured log events
+                                             recorded into the ring
+    log_dropped                              ring-capacity overwrites:
+                                             every oldest-event eviction
+                                             once the ring is full (a
+                                             fetch may or may not have
+                                             read it first — high values
+                                             mean raise DPT_LOG_CAP or
+                                             tail more often)
+    fleet_scrapes                            METRICS_FETCH scrape cycles
+                                             completed by the aggregator
+    fleet_scrape_errors                      scrape cycles that failed
+                                             whole (fan-out error)
+    fleet_width / fleet_reachable (gauges)   roster size vs members that
+                                             answered the last scrape
+    fleet_suspects / fleet_breakers_open (gauges)  quarantined members /
+                                             open breakers at last scrape
+    fleet_served_total / fleet_serve_errors_total (gauges)  fleet-summed
+                                             request counters from the
+                                             last scrape
+    profiles_captured                        PROFILE captures served by
+                                             this worker
+    profiles_stored                          profile:<id> artifacts
+                                             persisted by the service
+    profile_errors                           captures that failed or came
+                                             back empty/unsupported
 """
 
 import math

@@ -36,6 +36,7 @@ import time
 import queue as _stdlib_queue
 
 from ..checkpoint import ProverCheckpoint, StoreCheckpoint
+from ..obs import log as olog
 from ..prover import prove, prove_many
 from ..proof_io import serialize_proof
 from ..trace import Tracer
@@ -387,6 +388,8 @@ class WorkerPool:
         (expired before key build) and the pool loop (expired in the
         dispatch buffer)."""
         self.metrics.inc("jobs_shed")
+        olog.emit("service", "shed", level="warn", job_id=job.id,
+                  trace_id=job.trace_id, reason=reason)
         if self.journal is not None:
             self.journal.append(JN.SHED, job.id, reason=reason)
         self._clear_ckpt(job)
@@ -587,6 +590,9 @@ class WorkerPool:
             self._fail(job, f"{reason} (retries exhausted)")
             return
         self.metrics.inc("job_retries")
+        olog.emit("service", "retry", level="warn", job_id=job.id,
+                  trace_id=job.trace_id, retries=job.retries,
+                  reason=reason[:200])
         job.state = J.QUEUED
         if job.placement == "mesh" and self._requeue is not None:
             # back through the scheduler: the retry must be RE-PLACED on
@@ -612,6 +618,8 @@ class WorkerPool:
 
     def _fail(self, job, reason):
         self.metrics.inc("jobs_failed")
+        olog.emit("service", "job_failed", level="error", job_id=job.id,
+                  trace_id=job.trace_id, reason=reason[:200])
         self._clear_ckpt(job)
         if self.journal is not None:
             self.journal.append(JN.FAILED, job.id, reason=reason)
@@ -701,6 +709,8 @@ class WorkerPool:
             return
         self.metrics.inc("self_verify_failures")
         self.metrics.inc("proofs_blocked")
+        olog.emit("service", "self_verify_blocked", level="error",
+                  job_id=job.id, trace_id=job.trace_id)
         # never resume the corrupt state: the retry re-proves fresh
         # (deterministic bytes — a transient SDC yields a good proof,
         # a persistent one exhausts retries into a FAILED verdict,
@@ -740,6 +750,11 @@ class WorkerPool:
         finished prove."""
         from ..trace import merge_traces
         merged = merge_traces([tracer.dump()])
+        # trace-correlated structured log events (obs/log.py) ride the
+        # stored timeline too: every shed/retry/self-verify verdict for
+        # this trace id, queryable next to the spans it explains (the
+        # chrome export renders them as instant events)
+        merged["logs"] = olog.fetch(trace_id=job.trace_id)["events"]
         job.trace_dump = merged
         self.metrics.inc("trace_spans_recorded", len(merged["events"]))
         if self.store is None:

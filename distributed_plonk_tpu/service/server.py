@@ -23,6 +23,7 @@ import os
 import threading
 import time
 
+from ..obs import log as olog
 from ..runtime import native, protocol
 from ..store import ArtifactStore, aot_warmup, remote
 from . import jobs as J
@@ -111,6 +112,17 @@ class ProofService:
         # store (or DPT_AUTOTUNE=off) no plan is loaded and every kernel
         # path keeps the built-in defaults.
         self.autotune = {"source": "off"}
+        # fleet observability (obs/fleet.py): attach_fleet() arms it —
+        # the scraper aggregates every roster member's METRICS_FETCH
+        # snapshot into dpt_fleet_* series on /metrics and feeds the
+        # /fleet endpoint; profile captures land under profile:<id>
+        self.fleet = None
+        self.fleet_dispatcher = None
+        self._profiles = {}  # storeless fallback: id -> (meta, blob)
+        # structured logs (obs/log.py) publish their counters into this
+        # registry (per-process buffer; last-constructed service wins,
+        # which is the daemon case that matters)
+        olog.set_metrics(self.metrics)
         self._warm_backend = None
         self._warm_backend_lock = threading.Lock()
         self.jobs = {}
@@ -145,6 +157,63 @@ class ProofService:
         for host, port in registry.store_peers():
             self.buckets.add_peer(host, port)
         return self
+
+    def attach_fleet(self, dispatcher, interval_s=None, start=True):
+        """Arm the fleet observability plane (obs/fleet.py) for a
+        service whose backend proves on a worker fleet: an interval
+        scraper pulls every roster member's METRICS_FETCH snapshot,
+        folds fleet aggregates into this registry, and keeps the latest
+        per-worker snapshots for ObsServer's /metrics (labelled
+        dpt_fleet_* series) and /fleet endpoints; profile_fleet_worker
+        becomes available. Membership-driven by construction: the
+        scraper walks the dispatcher's CURRENT worker list each cycle,
+        so joins/leaves show up at the next scrape."""
+        from ..obs.fleet import FleetScraper
+        self.fleet_dispatcher = dispatcher
+        self.fleet = FleetScraper(dispatcher, self.metrics,
+                                  interval_s=interval_s)
+        if start:
+            self.fleet.start()
+        return self
+
+    def profile_fleet_worker(self, worker=0, duration_ms=None,
+                             kind="auto"):
+        """On-demand device/host profile of one fleet worker (PROFILE
+        wire tag): the capture lands as a content-addressed
+        profile:<id> artifact (store-backed when the service has one,
+        else a small in-memory table) served at /profile/<id>. Returns
+        {"profile_id", "format", "bytes", ...}. Raises RuntimeError
+        without an attached fleet."""
+        if self.fleet_dispatcher is None:
+            raise RuntimeError("no fleet attached (attach_fleet)")
+        from ..obs import profiling
+        meta, blob = self.fleet_dispatcher.profile_worker(
+            worker, duration_ms=duration_ms, kind=kind)
+        if not blob:
+            self.metrics.inc("profile_errors")
+            return dict(meta, profile_id=None)
+        pid = profiling.profile_id(blob)
+        meta = dict(meta, profile_id=pid)
+        if self.store is not None:
+            from ..store import keycache as KC
+            KC.store_profile(self.store, pid, blob, meta)
+        else:
+            self._profiles[pid] = (meta, blob)
+            while len(self._profiles) > 8:  # bounded fallback table
+                self._profiles.pop(next(iter(self._profiles)))
+        self.metrics.inc("profiles_stored")
+        olog.emit("obs", "profile_stored", worker=worker,
+                  profile_id=pid, format=meta.get("format"))
+        return meta
+
+    def load_profile(self, profile_id):
+        """(meta, blob) for one stored capture, or None."""
+        if self.store is not None:
+            from ..store import keycache as KC
+            hit = KC.load_profile(self.store, profile_id)
+            if hit is not None:
+                return hit
+        return self._profiles.get(profile_id)
 
     # -- local (in-process) API ----------------------------------------------
 
@@ -424,6 +493,8 @@ class ProofService:
     def shutdown(self):
         self.scheduler.stop()
         self.pool.shutdown()
+        if self.fleet is not None:
+            self.fleet.close()
         if self._listener is not None:
             self._listener.close()
         if self.journal is not None:
@@ -445,6 +516,9 @@ class ProofService:
         self.scheduler.stop()
         clean = self.pool.drain(deadline)
         self.metrics.inc("drain_clean" if clean else "drain_forced")
+        olog.emit("service", "drain", clean=bool(clean))
+        if self.fleet is not None:
+            self.fleet.close()
         if self._listener is not None:
             self._listener.close()
         if self.journal is not None:
@@ -465,6 +539,8 @@ class ProofService:
         self.queue.close()
         self.scheduler.crash()
         self.pool.crash()
+        if self.fleet is not None:
+            self.fleet.close()
         if self._listener is not None:
             self._listener.close()
         self._stopped.set()
@@ -609,6 +685,48 @@ class ProofService:
 
     # -- observability plane (serve.py --obs-port) -----------------------------
 
+    def merge_fleet_trace(self, job_id):
+        """Splice the attached fleet's distributed timeline into one
+        job's trace artifact: the service-side merged dump (pool spans +
+        service log events) plus Dispatcher.collect_trace() (dispatcher
+        and worker spans, dispatcher/membership/supervisor/worker log
+        events, offset-corrected) become ONE trace:<job_id> artifact —
+        the "one artifact per incident" surface. Worker span buffers are
+        fetch-and-forget and dispatcher-tracer-scoped, so call this
+        right after the job of interest finishes (the normal use: an
+        incident-bearing prove). Returns the merged dump (or None
+        without an attached fleet)."""
+        if self.fleet_dispatcher is None:
+            return None
+        from ..trace import merge_traces
+        job = self.get_job(job_id)
+        base = job.trace_dump if job is not None else None
+        fleet = self.fleet_dispatcher.collect_trace()
+        dumps = [d for d in (base, fleet) if d]
+        if not dumps:
+            return None
+        merged = merge_traces(dumps)
+        merged["logs"] = sorted(
+            ((base or {}).get("logs") or [])
+            + ((fleet or {}).get("logs") or []),
+            key=lambda e: e.get("ts", 0))
+        if job is not None and job.trace_id:
+            merged["trace_id"] = job.trace_id
+            # the fleet-side events were recorded under the DISPATCHER
+            # tracer's id (one dispatcher serves many jobs); splicing
+            # them into this job's artifact IS the attribution, so they
+            # take the job's trace id — grep one id, get the incident
+            merged["logs"] = [dict(e, trace_id=job.trace_id)
+                              for e in merged["logs"]]
+            job.trace_dump = merged
+        if self.store is not None:
+            from ..store import keycache as KC
+            try:
+                KC.store_trace(self.store, job_id, merged)
+            except Exception:  # best-effort, like _store_trace
+                self.metrics.inc("store_write_errors")
+        return merged
+
     def load_trace_merged(self, job_id):
         """The merged timeline for one job: the store artifact
         (trace:<job_id>) when present, else the finished Job's in-memory
@@ -624,16 +742,29 @@ class ProofService:
 
 class ObsServer:
     """Pull-based observability endpoint over stdlib HTTP (one thread per
-    request, read-only — it never mutates the service it watches):
+    request; read-only except the explicit /profile/capture trigger):
 
         /metrics         Prometheus text exposition (Metrics.to_prometheus:
                          counters, gauges incl. per-stage MFU, per-round
-                         latency summaries)
-        /healthz         JSON liveness: {"ok": true, uptime, queue depth,
-                         busy workers} — the LB / readiness probe target
+                         latency summaries) — with an attached fleet
+                         (ProofService.attach_fleet), PLUS the labelled
+                         per-worker dpt_fleet_* series of the latest scrape
+        /healthz         JSON readiness: queue depth, busy workers,
+                         draining — and, fleet-attached, the membership
+                         epoch, fleet width, suspects, and open breakers,
+                         so load balancers and the console read ONE truth
+        /fleet           JSON snapshot: roster with per-member breaker/
+                         suspect state and each member's full metrics
+                         snapshot (the scripts/console.py data source)
+        /logs            this process's structured-log ring (obs/log.py);
+                         ?trace_id=&since_seq=&limit= filter/tail
         /trace/<job_id>  the job's merged timeline as Chrome trace-event
                          JSON (load in chrome://tracing / Perfetto);
                          ?raw=1 returns the lossless merged dump instead
+        /profile/<id>    one stored on-demand capture (profile:<id>
+                         artifact — xplane tar.gz or pystacks JSON)
+        /profile/capture?worker=N&ms=M  arm a capture on fleet worker N
+                         and store it; answers {"profile_id": ...}
 
     Deliberately a separate listener from the proof-service wire plane:
     scrapers and dashboards must not compete with SUBMIT/RESULT frames,
@@ -679,6 +810,12 @@ class ObsServer:
             self._thread.join(timeout=5)
 
 
+def _query_params(query):
+    import urllib.parse
+    return {k: v[-1] for k, v in
+            urllib.parse.parse_qs(query, keep_blank_values=True).items()}
+
+
 def _obs_route(svc, path):
     """(status, content_type, body bytes) for one observability GET."""
     from ..trace import to_chrome_trace
@@ -688,17 +825,73 @@ def _obs_route(svc, path):
             "queue_depth": svc.queue.depth(),
             "queue_high_water": svc.queue.high_water,
         })
+        if svc.fleet is not None:
+            # the labelled per-worker series of the latest fleet scrape
+            # ride the same exposition: one scrape target for the whole
+            # deployment
+            text += svc.fleet.render()
         return 200, "text/plain; version=0.0.4; charset=utf-8", \
             text.encode()
     if path == "/healthz":
-        body = protocol.encode_json({
+        body = {
             "ok": True,
             "uptime_s": round(time.monotonic() - svc.metrics.started_at, 3),
             "queue_depth": svc.queue.depth(),
             "busy_workers": len(svc.pool.busy()),
             "draining": svc.queue.closed(),
+            # fleet summary (None without an attached fleet): the same
+            # readiness truth the console and /fleet read — a LB can
+            # route on width/suspects without scraping the full snapshot
+            "fleet": None,
+        }
+        if svc.fleet_dispatcher is not None:
+            d = svc.fleet_dispatcher
+            snap = d.tracker.snapshot()
+            body["fleet"] = {
+                "epoch": d.epoch,
+                "width": len(snap),
+                "usable": sum(1 for s in snap if not s["open"]),
+                "suspects": sum(1 for s in snap if s["suspect"]),
+                "breakers_open": sum(1 for s in snap if s["open"]),
+            }
+        return 200, "application/json", protocol.encode_json(body)
+    if path == "/fleet":
+        if svc.fleet is None:
+            return 404, "application/json", protocol.encode_json(
+                {"error": "no fleet attached "
+                          "(ProofService.attach_fleet)"})
+        out = svc.fleet.fleet_json(extra={
+            "queue_depth": svc.queue.depth(),
+            "draining": svc.queue.closed(),
         })
-        return 200, "application/json", body
+        return 200, "application/json", protocol.encode_json(out)
+    if path == "/logs":
+        q = _query_params(query)
+        out = olog.fetch(trace_id=q.get("trace_id") or None,
+                         since_seq=int(q.get("since_seq") or 0),
+                         limit=int(q["limit"]) if q.get("limit") else None)
+        return 200, "application/json", protocol.encode_json(out)
+    if path == "/profile/capture":
+        q = _query_params(query)
+        try:
+            meta = svc.profile_fleet_worker(
+                worker=int(q.get("worker") or 0),
+                duration_ms=int(q["ms"]) if q.get("ms") else None,
+                kind=q.get("kind") or "auto")
+        except (RuntimeError, ValueError, ConnectionError, OSError) as e:
+            return 400, "application/json", protocol.encode_json(
+                {"error": repr(e)})
+        return 200, "application/json", protocol.encode_json(meta)
+    if path.startswith("/profile/"):
+        pid = path[len("/profile/"):]
+        hit = svc.load_profile(pid)
+        if hit is None:
+            return 404, "application/json", protocol.encode_json(
+                {"error": f"no profile {pid!r}"})
+        meta, blob = hit
+        ctype = "application/gzip" \
+            if meta.get("format") == "xplane-targz" else "application/json"
+        return 200, ctype, blob
     if path.startswith("/trace/"):
         job_id = path[len("/trace/"):]
         merged = svc.load_trace_merged(job_id)
@@ -711,4 +904,6 @@ def _obs_route(svc, path):
             protocol.encode_json(to_chrome_trace(merged))
     return 404, "application/json", protocol.encode_json(
         {"error": f"unknown path {path!r}",
-         "endpoints": ["/metrics", "/healthz", "/trace/<job_id>"]})
+         "endpoints": ["/metrics", "/healthz", "/fleet", "/logs",
+                       "/trace/<job_id>", "/profile/<id>",
+                       "/profile/capture"]})

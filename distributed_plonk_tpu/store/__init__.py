@@ -25,7 +25,8 @@ from .artifacts import ArtifactStore
 from .keycache import (bucket_store_key, serialize_bucket,
                        deserialize_bucket, store_bucket, load_bucket,
                        proof_store_key, store_proof, load_proof,
-                       trace_store_key, store_trace, load_trace)
+                       trace_store_key, store_trace, load_trace,
+                       profile_store_key, store_profile, load_profile)
 from .warmstart import (set_jax_cache_env, configure_jax_cache,
                         aot_warmup, warm_spec)
 from .remote import FetchError, fetch_blob, fetch_into
@@ -37,6 +38,7 @@ __all__ = [
     "deserialize_bucket", "store_bucket", "load_bucket",
     "proof_store_key", "store_proof", "load_proof",
     "trace_store_key", "store_trace", "load_trace",
+    "profile_store_key", "store_profile", "load_profile",
     "set_jax_cache_env", "configure_jax_cache", "aot_warmup", "warm_spec",
     "FetchError", "fetch_blob", "fetch_into",
     "plan_store_key", "store_plan", "load_plan", "load_or_run",

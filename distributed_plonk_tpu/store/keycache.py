@@ -123,6 +123,33 @@ def load_trace(store, job_id):
         return None
 
 
+# -- on-demand profile artifacts (obs/profiling.py) ---------------------------
+# One PROFILE-tag capture (jax.profiler xplane tar.gz, or the pystacks
+# JSON fallback) joins the content-addressed surface: profile:<id> where
+# <id> is the blob's own digest prefix, served at /profile/<id> and
+# linked from the trace timeline's obs/profile span.
+
+def profile_store_key(profile_id):
+    return f"profile:{profile_id}"
+
+
+def store_profile(store, profile_id, blob, meta=None):
+    """Persist one capture blob; returns its content digest."""
+    m = {"kind": "profile", "profile_id": profile_id}
+    m.update({k: v for k, v in (meta or {}).items()
+              if isinstance(v, (int, float, str, bool))})
+    return store.put(profile_store_key(profile_id), blob, meta=m)
+
+
+def load_profile(store, profile_id):
+    """-> (meta, blob), or None (evicted / integrity failure)."""
+    hit = store.get_entry(profile_store_key(profile_id))
+    if hit is None:
+        return None
+    blob, _digest, meta = hit
+    return meta, blob
+
+
 def _fr_bytes(x):
     assert 0 <= x < R_MOD
     return int(x).to_bytes(_FR, "little")

@@ -374,6 +374,21 @@ def to_chrome_trace(merged):
             "tid": ev.get("tid", 0),
             "args": args,
         })
+    # structured log events (obs/log.py, merged in by collect_trace /
+    # the service pool) render as instant events on the same timeline:
+    # quarantines/replans/respawns line up visually under the spans
+    for ev in merged.get("logs") or []:
+        out.append({
+            "ph": "i",
+            "name": f"{ev.get('subsystem', '?')}/{ev.get('event', '?')}",
+            "cat": "log",
+            "s": "g",  # global-scope instant marker
+            "ts": round((float(ev.get("ts", base)) - base) * 1e6, 1),
+            "pid": ev.get("pid", 0),
+            "tid": 0,
+            "args": {k: v for k, v in ev.items()
+                     if k not in ("ts", "pid")},
+        })
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": {"trace_id": merged.get("trace_id"),
                           "base_ts_s": round(base, 6)}}

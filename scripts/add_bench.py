@@ -86,6 +86,15 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    # normalized trajectory record (scripts/bench_record.py): one
+    # schema-versioned JSONL line per run so bench_compare.py and future
+    # sessions can diff this tool's history without knowing its shape
+    try:
+        import bench_record as BR
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        BR.append(BR.normalize("add_bench", results), repo=repo)
+    except Exception:
+        pass
     print(line)
 
 

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Perf-regression gate over the bench trajectory (ISSUE 15 pillar 4).
+
+    python scripts/bench_compare.py                 # check the committed
+                                                    # trajectory (ci.sh
+                                                    # benchcheck)
+    python scripts/bench_compare.py --line '<json>' # gate one fresh
+                                                    # bench line against
+                                                    # the latest committed
+                                                    # record of its basis
+    python scripts/bench_compare.py --report        # full history diff,
+                                                    # informational
+
+Reads BOTH formats of the perf history: the legacy driver-wrapped
+BENCH_r*.json files and the normalized bench_artifacts/trajectory.jsonl
+records that bench.py / scripts/add_bench.py now append (schema 1, see
+scripts/bench_record.py). Comparison is BASIS-AWARE — chip lines compare
+only against chip lines, degraded (host-CPU fallback) only against
+degraded — and key-scoped by the WATCH tolerance table, so a relay
+outage or a brand-new metric can never read as a regression.
+
+Exit code: 0 = no watched key regressed beyond its tolerance on the
+gated comparison (the LATEST record vs its same-basis predecessor, or
+--line vs the latest committed record); 1 = at least one did, printed
+loudly. Deliberately non-flaky: the default mode runs NO measurement —
+it only reads committed numbers.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, HERE)
+
+import bench_record as BR  # noqa: E402
+
+
+def _print_regressions(tag, regs):
+    for r in regs:
+        print(f"[bench_compare] REGRESSION {tag}: {r['key']} "
+              f"{r['prev']} -> {r['cur']} "
+              f"(change {r['change']}, tolerance {r['tol']}, "
+              f"want {r['direction']})", file=sys.stderr)
+
+
+def check_committed(repo, scale, verbose=False):
+    """Gate: the latest trajectory record vs its same-basis predecessor.
+    Returns (regressions, detail dict)."""
+    records = BR.load_trajectory(repo)
+    if not records:
+        return [], {"records": 0, "note": "no trajectory to check"}
+    cur = records[-1]
+    prev = BR.latest_of_basis(records, cur.get("basis"),
+                              before=len(records) - 1)
+    detail = {"records": len(records), "basis": cur.get("basis"),
+              "cur_source": cur.get("source"), "cur_run": cur.get("run")}
+    if prev is None:
+        detail["note"] = "first record of its basis: nothing to gate"
+        return [], detail
+    regs = BR.compare(prev, cur, scale=scale)
+    detail["prev_run"] = prev.get("run")
+    detail["compared_keys"] = sum(
+        1 for k in (cur.get("keys") or {})
+        if BR.watch_rule(k) and k in (prev.get("keys") or {}))
+    if verbose:
+        # informational sweep over the whole history (never gates)
+        for i in range(1, len(records)):
+            p = BR.latest_of_basis(records, records[i].get("basis"),
+                                   before=i)
+            if p is None:
+                continue
+            for r in BR.compare(p, records[i], scale=scale):
+                print(f"[bench_compare] note run {records[i].get('run')}: "
+                      f"{r['key']} {r['prev']} -> {r['cur']}",
+                      file=sys.stderr)
+    return regs, detail
+
+
+def check_line(repo, line, scale):
+    """Gate one fresh bench line (a JSON dict string) against the latest
+    committed record of the same basis."""
+    data = json.loads(line)
+    cur = BR.normalize("bench", data)
+    records = BR.load_trajectory(repo)
+    prev = BR.latest_of_basis(records, cur["basis"])
+    if prev is None:
+        return [], {"note": f"no committed {cur['basis']} record",
+                    "basis": cur["basis"]}
+    regs = BR.compare(prev, cur, scale=scale)
+    return regs, {"basis": cur["basis"], "prev_run": prev.get("run"),
+                  "compared_keys": sum(
+                      1 for k in cur["keys"]
+                      if BR.watch_rule(k) and k in (prev.get("keys") or {}))}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--line", default=None,
+                    help="one bench-line JSON dict to gate against the "
+                         "committed trajectory")
+    ap.add_argument("--file", default=None,
+                    help="like --line but read the JSON from a file")
+    ap.add_argument("--report", action="store_true",
+                    help="also print the informational full-history diff")
+    ap.add_argument("--tolerance-scale", type=float, default=1.0,
+                    help="multiply every WATCH tolerance (a loaded CI box "
+                         "can widen the gate without editing the table)")
+    args = ap.parse_args()
+
+    if args.file:
+        with open(args.file) as f:
+            args.line = f.read().strip().splitlines()[-1]
+    if args.line:
+        regs, detail = check_line(args.repo, args.line,
+                                  args.tolerance_scale)
+        tag = "line-vs-committed"
+    else:
+        regs, detail = check_committed(args.repo, args.tolerance_scale,
+                                       verbose=args.report)
+        tag = "trajectory"
+    _print_regressions(tag, regs)
+    print(json.dumps({"ok": not regs, "mode": tag,
+                      "regressions": regs, **detail}))
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

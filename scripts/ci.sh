@@ -20,6 +20,13 @@
 #                          precedence, DPT_AUTOTUNE=off parity, service +
 #                          fleet-worker plan pickup — tiny shapes,
 #                          interpret-safe budget (XLA:CPU only)
+#   scripts/ci.sh benchcheck  perf-regression smoke (ISSUE 15): gate the
+#                          COMMITTED bench trajectory (BENCH_r*.json +
+#                          bench_artifacts/trajectory.jsonl) through
+#                          scripts/bench_compare.py — basis-aware,
+#                          tolerance-table scoped, runs NO measurement
+#                          (non-flaky by construction); a watched key
+#                          regressing beyond tolerance exits 1 loudly
 #   scripts/ci.sh chaos    fault-domain + observability suite, PLUS the
 #                          result-integrity suite (ISSUE 13): injected
 #                          silent data corruption (wrong MSM partial /
@@ -54,12 +61,20 @@ cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
 fi
+if [ "$1" = "benchcheck" ]; then
+  exec env JAX_PLATFORMS=cpu python scripts/bench_compare.py
+fi
 if [ "$1" = "chaos" ]; then
+  # the fleet-observability suite rides with the fault-domain tiers (it
+  # is jax-free and exercises the same real-TCP worker topology), and
+  # the benchcheck smoke runs first — it is instant and read-only
+  bash scripts/ci.sh benchcheck || exit 1
   exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_runtime_faults.py tests/test_membership.py \
     tests/test_integrity.py \
     tests/test_service_journal.py \
-    tests/test_trace.py tests/test_obs.py tests/test_placement.py \
+    tests/test_trace.py tests/test_obs.py tests/test_fleet_obs.py \
+    tests/test_placement.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "autotune" ]; then

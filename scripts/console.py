@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Live fleet console: one terminal pane over the observability plane.
+
+    python scripts/console.py --obs 127.0.0.1:9560            # live, 2s
+    python scripts/console.py --obs 127.0.0.1:9560 --once     # snapshot
+    python scripts/console.py --obs 127.0.0.1:9560 --logs 10  # w/ log tail
+
+Renders the /fleet + /healthz JSON of a serve.py --obs-port daemon (or
+any ObsServer): service readiness (queue depth, busy workers, draining),
+the membership summary (epoch, width, suspects, open breakers), and one
+row per fleet member — reachability, breaker/suspect state, served
+request counters, live kernel gflops/MFU gauges, injected-SDC count —
+plus an optional tail of the structured log ring (/logs). Plain ANSI,
+no curses: works over any ssh session, and --once makes it scriptable
+(the loadgen soak and tests use it as the "can an operator actually see
+the fleet" check)."""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _get(base, path, timeout=5):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _fmt_member(m):
+    state = "LEFT" if m.get("left") else \
+        "SUSPECT" if m.get("suspect") else \
+        "OPEN" if not m.get("usable") else \
+        "up" if m.get("reachable") else "down"
+    snap = m.get("snapshot") or {}
+    ctr = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    served = sum(v for k, v in ctr.items() if k.startswith("served_"))
+    kernels = ", ".join(
+        f"{k[len('kernel_'):-len('_gflops')]}={v:g}"
+        for k, v in sorted(gauges.items())
+        if k.startswith("kernel_") and k.endswith("_gflops"))
+    return (f"  [{m['index']:>2}] {m.get('addr', '?'):<21} {state:<7} "
+            f"served={served:<6} sdc={snap.get('sdc_injected', 0):<3} "
+            f"epoch={snap.get('epoch', '?'):<3} "
+            f"gflops({kernels or '-'})")
+
+
+def render(base, log_tail=0):
+    lines = []
+    h = _get(base, "/healthz")
+    flt = h.get("fleet")
+    lines.append(f"service  ok={h.get('ok')} uptime={h.get('uptime_s')}s "
+                 f"queue={h.get('queue_depth')} "
+                 f"busy={h.get('busy_workers')} "
+                 f"draining={h.get('draining')}")
+    if flt:
+        lines.append(f"fleet    epoch={flt['epoch']} width={flt['width']} "
+                     f"usable={flt['usable']} suspects={flt['suspects']} "
+                     f"breakers_open={flt['breakers_open']}")
+        try:
+            fl = _get(base, "/fleet")
+            for m in fl.get("members", []):
+                lines.append(_fmt_member(m))
+        except Exception as e:  # /fleet needs attach_fleet; say so once
+            lines.append(f"  (no /fleet snapshot: {e})")
+    else:
+        lines.append("fleet    (none attached)")
+    if log_tail:
+        try:
+            lg = _get(base, f"/logs?limit={log_tail}")
+            lines.append(f"logs     (last {log_tail} of seq "
+                         f"{lg.get('seq')})")
+            for e in lg.get("events", []):
+                ts = time.strftime("%H:%M:%S",
+                                   time.localtime(e.get("ts", 0)))
+                extra = {k: v for k, v in e.items()
+                         if k not in ("ts", "seq", "level", "subsystem",
+                                      "event", "proc", "pid")}
+                lines.append(f"  {ts} {e.get('level', '?'):<5} "
+                             f"{e.get('subsystem', '?')}/"
+                             f"{e.get('event', '?')} {extra}")
+        except Exception as e:
+            lines.append(f"logs     (unavailable: {e})")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--obs", required=True,
+                    help="host:port of the ObsServer (serve.py banner's "
+                         "'obs' field)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scriptable)")
+    ap.add_argument("--logs", type=int, default=0, metavar="N",
+                    help="also tail the last N structured log events")
+    args = ap.parse_args()
+    base = f"http://{args.obs}"
+    if args.once:
+        print(render(base, log_tail=args.logs))
+        return 0
+    try:
+        while True:
+            frame = render(base, log_tail=args.logs)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

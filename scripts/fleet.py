@@ -72,6 +72,11 @@ def main():
     ap.add_argument("--watch-s", type=float, default=None,
                     help="idle-serve this long (default: forever without "
                          "--prove)")
+    ap.add_argument("--obs-dump", action="store_true",
+                    help="before exiting, print one fleet observability "
+                         "scrape (METRICS_FETCH per member: served "
+                         "counters, kernel gauges, log-ring depth) — the "
+                         "dispatcher-side pane of ISSUE 15")
     args = ap.parse_args()
 
     metrics = Metrics()
@@ -132,6 +137,17 @@ def main():
             }))
         else:
             stop.wait(args.watch_s)
+        if args.obs_dump:
+            entries = d.fleet_metrics()
+            print(json.dumps({"fleet_obs": [
+                {"index": e["index"], "addr": e["addr"],
+                 "usable": e["usable"], "suspect": e["suspect"],
+                 "served": sum(
+                     v for k, v in ((e["snapshot"] or {})
+                                    .get("counters") or {}).items()
+                     if k.startswith("served_")),
+                 "log_seq": (e["snapshot"] or {}).get("log_seq", 0)}
+                for e in entries]}))
         return 0
     finally:
         sup.stop()

@@ -246,6 +246,7 @@ def run_sdc_soak(args):
     sup.attach_registry(d.membership)
     svc = None
     results = []
+    obs_report = {}
     try:
         deadline = time.monotonic() + args.timeout
         while time.monotonic() < deadline:
@@ -287,6 +288,31 @@ def run_sdc_soak(args):
         # (corrupt incarnations that were already replaced undercount)
         sdc_injected = sum((h or {}).get("sdc_injected", 0)
                            for h in d.health())
+        # fleet observability round trip (ISSUE 15): the soak exercises
+        # the whole new plane end to end — METRICS_FETCH scrape rendered
+        # to labelled series, LOG_FETCH event counts, and one PROFILE
+        # capture — so a soak that passes proves an operator could have
+        # WATCHED it pass
+        obs_report = {}
+        try:
+            from distributed_plonk_tpu.obs import fleet as OF
+            entries = d.fleet_metrics()
+            obs_report["fleet_scraped"] = sum(
+                1 for e in entries if e.get("snapshot"))
+            obs_report["fleet_series"] = sum(
+                1 for line in OF.render_prom(entries).splitlines()
+                if line and not line.startswith("#"))
+            obs_report["log_events_fetched"] = sum(
+                len(l["events"]) for l in d.fetch_logs())
+            # profile a worker that is actually schedulable (a corrupt
+            # member may be mid-quarantine right now — that's the soak)
+            usable = d.tracker.usable_set()
+            meta, blob = d.profile_worker(usable[0] if usable else 0,
+                                          duration_ms=100)
+            obs_report["profile_ok"] = bool(blob)
+            obs_report["profile_format"] = meta.get("format")
+        except Exception as e:  # noqa: BLE001 - report, never fail a soak
+            obs_report["error"] = repr(e)
     finally:
         sup.stop()
         try:
@@ -332,6 +358,7 @@ def run_sdc_soak(args):
             "fft_replans": fc.get("fleet_fft_replans", 0),
             "range_adoptions": fc.get("fleet_range_adoptions", 0),
         },
+        "obs": obs_report,
     }
     print(json.dumps(summary), flush=True)
     return 0 if ok else 1
@@ -599,6 +626,12 @@ def main():
             "spans_total": sum(r.get("trace_spans") or 0 for r in results),
             "spans_recorded":
                 ctr.get("trace_spans_recorded", 0),
+        },
+        # observability plane exercised by this run (structured logs are
+        # recorded service-side for every shed/retry/verdict; the full
+        # fleet scrape/profile round trip lives in the --sdc-rate soak)
+        "obs": {
+            "log_events_recorded": ctr.get("log_events", 0),
         },
         # key_builds == bucket_misses: 0 on a warm-store rerun of the same
         # shape mix (the ISSUE-2 acceptance check; see --store-dir)

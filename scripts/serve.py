@@ -101,6 +101,12 @@ def main():
                          "blob from a warm peer (digest-verified) before "
                          "paying for a full build — a scaled-out replica "
                          "serves warm after one network copy")
+    ap.add_argument("--log-dir", default=None,
+                    help="structured-log JSONL sink (obs/log.py): every "
+                         "shed/retry/quarantine verdict appends one "
+                         "trace-correlated JSON line to "
+                         "<dir>/serve-<pid>.jsonl; the env DPT_LOG_DIR "
+                         "does the same for worker subprocesses")
     ap.add_argument("--obs-port", type=int, default=None,
                     help="observability HTTP port (0 = ephemeral): serves "
                          "/metrics (Prometheus text exposition incl. "
@@ -124,9 +130,16 @@ def main():
         # alongside the keys they serve
         from distributed_plonk_tpu.store import set_jax_cache_env
         set_jax_cache_env(args.store_dir)
+    from distributed_plonk_tpu.obs import log as olog
     from distributed_plonk_tpu.runtime.faults import FaultInjector
     from distributed_plonk_tpu.service import ProofService
     from distributed_plonk_tpu.service.server import ObsServer
+
+    log_path = None
+    if args.log_dir is not None:
+        log_path = olog.configure(log_dir=args.log_dir, proc="serve")
+        if log_path is None:
+            raise SystemExit(f"--log-dir: {args.log_dir!r} is not writable")
 
     faults = None
     if args.chaos:
@@ -170,6 +183,7 @@ def main():
                       "obs": f"{obs.host}:{obs.port}" if obs else None,
                       "workers": args.workers, "chaos": args.chaos,
                       "store": args.store_dir, "journal": journal_dir,
+                      "log_file": log_path,
                       "autotune": svc.autotune}),
           flush=True)
     svc.serve_forever()

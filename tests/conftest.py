@@ -45,7 +45,7 @@ _HOST_TIER = {
     "test_encoding", "test_rescue_merkle", "test_prove_verify",
     "test_proof_golden", "test_imports", "test_checkpoint",
     "test_service", "test_store", "test_runtime_faults",
-    "test_membership", "test_integrity",
+    "test_membership", "test_integrity", "test_fleet_obs",
 }
 
 
