@@ -797,6 +797,71 @@ def service_roundtrip_main():
                 m_on["counters"].get("self_verify_checks", 0),
         }
 
+    def autoscale_canary():
+        """The closed-loop control-law canary (ISSUE 16): drive the
+        Autoscaler's tick() directly against fake sensors/actuators —
+        no threads, no sockets, an injected clock — through a ramp
+        (queue breach -> scale_up), an idle tail (-> scale_down), a dry
+        arm that must make ZERO actuator calls, and the off arm where
+        attach() must return None (bit-parity). Returns the verdict +
+        the dry arm's call count (pinned at 0 by the gate)."""
+        from distributed_plonk_tpu.service import autoscale as AS
+
+        def arm(mode):
+            calls = {"n": 0, "workers": 2}
+
+            class Act:
+                def worker_count(self):
+                    return calls["workers"]
+
+                def add_worker(self):
+                    calls["n"] += 1
+                    calls["workers"] += 1
+                    return calls["workers"] - 1
+
+                def retire_worker(self):
+                    calls["n"] += 1
+                    calls["workers"] -= 1
+                    return calls["workers"]
+
+                def lease_capacity(self, frac):
+                    calls["n"] += 1
+                    return 4
+
+                def shed_lowest(self, below_rank):
+                    calls["n"] += 1
+                    return "batch"
+
+            box = {"depth": 8, "t": 0.0}
+            asc = AS.Autoscaler(
+                mode=mode, tick_s=0.01, min_workers=1, max_workers=4,
+                up_queue_per_worker=2, up_ticks=2, down_ticks=2,
+                up_cooldown_s=0, down_cooldown_s=0,
+                sensors=lambda: {"queue_depth": box["depth"],
+                                 "queue_by_class":
+                                     {"standard": box["depth"]},
+                                 "max_depth": 64, "busy_workers":
+                                     1 if box["depth"] else 0},
+                actuators=Act(), clock=lambda: box["t"])
+            acts = []
+            for _ in range(3):          # ramp: breach streak -> up
+                box["t"] += 1
+                acts += [d["action"] for d in asc.tick()]
+            box["depth"] = 0
+            for _ in range(3):          # idle tail -> down
+                box["t"] += 1
+                acts += [d["action"] for d in asc.tick()]
+            return acts, calls["n"]
+
+        live_acts, live_calls = arm("1")
+        dry_acts, dry_calls = arm("dry")
+        off_is_none = AS.attach(None, mode="0") is None
+        ok = ("scale_up" in live_acts and "scale_down" in live_acts
+              and live_calls >= 2 and "scale_up" in dry_acts
+              and dry_calls == 0 and off_is_none)
+        return {"autoscale_canary_ok": bool(ok),
+                "autoscale_dry_actuator_calls": dry_calls}
+
     try:
         cold_s, st, header, blob, m_cold, trace_info = one_run(seed=42)
         warm_s, st_w, _hw, _bw, m_warm, _tw = one_run(seed=43)
@@ -811,6 +876,11 @@ def service_roundtrip_main():
         except Exception as e:  # diagnostic; never fail the canary
             sv_ab = {"self_verify_ab_error": repr(e),
                      "self_verify_overhead_pct": None}
+        try:
+            as_canary = autoscale_canary()
+        except Exception as e:  # diagnostic; never fail the canary
+            as_canary = {"autoscale_canary_error": repr(e),
+                         "autoscale_canary_ok": False}
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
@@ -847,6 +917,15 @@ def service_roundtrip_main():
             **batch_ab,
             # verify-before-serve overhead (the ISSUE 13 in-run A/B)
             **sv_ab,
+            # closed-loop control law (the ISSUE 16 canary): ramp ->
+            # scale_up, idle -> scale_down, dry arm pinned at ZERO
+            # actuator calls, off arm attaches nothing
+            **as_canary,
+            # standard-class serving latency under SLO accounting (the
+            # cold run's jobs are classless -> standard by default)
+            "slo_p95_standard_s":
+                (m_cold["histograms"].get("slo_roundtrip/standard")
+                 or {}).get("p95_s"),
             "service_wait_s": st["wait_s"],
             "service_run_s": st["run_s"],
             "service_jobs_completed":

@@ -39,6 +39,10 @@ two or more spaces:
                  report, profile captures, injected SDC (chaos)
     obs          the observability plane itself: scrape errors,
                  profile-capture failures, log-sink errors
+    autoscale    closed-loop controller decisions (service/autoscale.py):
+                 scale_up/scale_down verdicts, lease resizes, pressure
+                 sheds, loop start — dry-mode recommendations included
+                 (applied=False)
 
 Levels: debug < info < warn < error (no filtering on record — the ring
 is small and the consumer filters; the FILE sink honors DPT_LOG_LEVEL).

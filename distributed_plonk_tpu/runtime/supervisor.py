@@ -15,8 +15,20 @@ respawned forever: `flap_cap` respawns inside `flap_window_s` marks the
 slot FAILED, stops respawning it, and (when the membership address is
 known) declares it gone with a LEAVE so the fleet stops probing the
 corpse. Counters land in the duck-typed metrics registry:
-worker_respawns / worker_flap_capped / supervisor_probe_misses, gauge
-supervised_workers.
+worker_respawns / worker_flap_capped / supervisor_probe_misses /
+worker_retires, gauge supervised_workers (active slots: not failed, not
+retired).
+
+Scale-down is graceful (`retire_slot`, the autoscaler's down actuator):
+drain (HEALTH's fft_tasks table empties) -> membership LEAVE -> SIGTERM,
+escalating to SIGKILL only past DPT_SUP_RETIRE_TIMEOUT_S per phase. The
+ordering is the no-lost-work contract: the worker finishes or
+checkpoints its in-flight ranges BEFORE the fleet stops routing to it,
+and is only signalled after it is out of the roster. A retired slot is
+NOT a flap — it leaves supervision entirely: the watch loop skips it, it
+is never respawned, and it adds nothing to the flap window
+(tests/test_autoscale.py pins worker_flap_capped staying 0 across a
+retire).
 
 Startup is graced: the miss budget only ticks once a worker has answered
 its FIRST probe — before that, only `startup_grace_s` elapsing counts as
@@ -34,6 +46,8 @@ Knobs (env, read at construction; constructor args override):
     DPT_SUP_BACKOFF_MAX_MS  respawn delay ceiling (10000)
     DPT_SUP_FLAP_CAP        respawns inside the window before giving up (5)
     DPT_SUP_FLAP_WINDOW_S   the flap-counting window (60)
+    DPT_SUP_RETIRE_TIMEOUT_S  retire_slot per-phase budget: drain wait,
+                            then SIGTERM wait before SIGKILL (20)
 """
 
 import os
@@ -86,6 +100,7 @@ class _Slot:
         self.answered = False  # this incarnation answered >= 1 probe
         self.healthy_since = None
         self.failed = False
+        self.retired = False
         self.respawns = 0
 
 
@@ -126,6 +141,8 @@ class WorkerSupervisor:
             int(os.environ.get("DPT_SUP_FLAP_CAP", "5"))
         self.flap_window_s = flap_window_s if flap_window_s is not None \
             else float(os.environ.get("DPT_SUP_FLAP_WINDOW_S", "60"))
+        self.retire_timeout_s = float(
+            os.environ.get("DPT_SUP_RETIRE_TIMEOUT_S", "20"))
         self._rng = rng or random.Random()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -194,6 +211,63 @@ class WorkerSupervisor:
         self._spawn(i)
         return i
 
+    def retire_slot(self, i, timeout_s=None):
+        """Graceful scale-down of slot i: drain -> LEAVE -> SIGTERM, with
+        SIGKILL escalation only past the per-phase budget
+        (DPT_SUP_RETIRE_TIMEOUT_S). Order is the no-lost-work contract:
+        the worker first empties its in-flight task table (HEALTH's
+        fft_tasks — finished or checkpointed), is THEN declared gone
+        through the membership registry so nothing new routes to it, and
+        only after that receives a signal — a retiring worker is never
+        killed mid-prove. Marking `retired` under the lock first takes
+        the slot out of supervision atomically: the watch loop skips it,
+        nothing respawns it, and the retire is not a flap. Returns True
+        iff this call performed the retire (False: already retired /
+        failed)."""
+        budget = self.retire_timeout_s if timeout_s is None else timeout_s
+        with self._lock:
+            slot = self.slots[i]
+            if slot.retired or slot.failed:
+                return False
+            slot.retired = True
+            proc = slot.proc
+        olog.emit("supervisor", "retire", slot=i, port=slot.port)
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if proc is None or proc.poll() is not None:
+                break  # already dead == already drained
+            snap = WorkerHandle(self.host, slot.port).probe(
+                timeout_ms=self.probe_timeout_ms)
+            if snap is not None and not snap.get("fft_tasks"):
+                break
+            time.sleep(min(0.1, self.probe_interval_s))
+        # LEAVE before any signal: the fleet must stop routing first
+        membership.leave_fleet(self.join_host, self.join_port,
+                               self.host, slot.port)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=max(1.0, budget))
+            except subprocess.TimeoutExpired:
+                # SIGTERM ignored past the budget — the member already
+                # LEAVEd and drained, so a hard kill cannot lose work
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        self.metrics.inc("worker_retires")
+        self.metrics.gauge("supervised_workers", self.active_count())
+        olog.emit("supervisor", "retired", slot=i, port=slot.port)
+        return True
+
+    def active_count(self):
+        """Slots still under supervision (not failed, not retired) —
+        the autoscaler's worker-count sensor."""
+        with self._lock:
+            return sum(1 for s in self.slots
+                       if not s.failed and not s.retired)
+
     # -- chaos / introspection -------------------------------------------------
 
     def slot_for_port(self, port):
@@ -234,7 +308,7 @@ class WorkerSupervisor:
     def snapshot(self):
         with self._lock:
             return [{"port": s.port, "respawns": s.respawns,
-                     "failed": s.failed,
+                     "failed": s.failed, "retired": s.retired,
                      "alive": s.proc is not None and s.proc.poll() is None}
                     for s in self.slots]
 
@@ -255,7 +329,7 @@ class WorkerSupervisor:
         """Start slot i's subprocess (caller ensured backoff elapsed)."""
         with self._lock:
             slot = self.slots[i]
-            if slot.failed or self._stop.is_set():
+            if slot.failed or slot.retired or self._stop.is_set():
                 return
             now = time.monotonic()
             slot.spawn_times = [t for t in slot.spawn_times
@@ -275,7 +349,7 @@ class WorkerSupervisor:
                       port=slot.port, respawns=slot.respawns)
         else:
             olog.emit("supervisor", "spawn", slot=i, port=slot.port)
-        self.metrics.gauge("supervised_workers", len(self.slots))
+        self.metrics.gauge("supervised_workers", self.active_count())
 
     def _schedule_respawn(self, i):
         """Slot i's process is dead/wedged: arm the next spawn time with
@@ -285,7 +359,7 @@ class WorkerSupervisor:
         gave_up = False
         with self._lock:
             slot = self.slots[i]
-            if slot.failed:
+            if slot.failed or slot.retired:
                 return
             recent = [t for t in slot.spawn_times
                       if now - t <= self.flap_window_s]
@@ -311,7 +385,7 @@ class WorkerSupervisor:
         now = time.monotonic()
         with self._lock:
             slot = self.slots[i]
-            if slot.failed:
+            if slot.failed or slot.retired:
                 return
             proc, next_spawn = slot.proc, slot.next_spawn
         if proc is None or proc.poll() is not None:

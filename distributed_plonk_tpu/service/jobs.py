@@ -25,6 +25,7 @@ vk serializer. This is a test-setup service, not a production ceremony.
 """
 
 import itertools
+import os
 import random
 import threading
 import time
@@ -38,6 +39,34 @@ from ..trace import new_trace_id
 TEST_TAU = 0xDEADBEEF
 
 _SPEC_KINDS = ("toy", "merkle")
+
+# SLO serving classes (ISSUE 16): flat ttl_s shedding grows into three
+# classes with per-class queue priority (flagship pops first), per-class
+# default deadlines (DPT_TTL_<CLASS>_S), and shed-lowest-class-first under
+# pressure (queue.steal_lowest / the autoscaler). A spec without a class
+# is `standard`, and an all-standard stream sorts, sheds, and proves
+# exactly like the pre-class tree — that back-compat is the contract
+# tests/test_autoscale.py pins.
+SLO_CLASSES = ("flagship", "standard", "batch")
+SLO_RANK = {"batch": 0, "standard": 1, "flagship": 2}
+DEFAULT_SLO = "standard"
+
+
+def class_default_ttl(slo):
+    """Per-class default TTL seconds (`DPT_TTL_FLAGSHIP_S` /
+    `DPT_TTL_STANDARD_S` / `DPT_TTL_BATCH_S`), read at call time so an
+    operator (or test) can set one without rebuilding the service. The
+    explicit per-job `ttl_s` always overrides. Unset or non-positive
+    means no default deadline — exactly the pre-class behavior, so
+    classless deployments keep bit-parity."""
+    raw = os.environ.get("DPT_TTL_%s_S" % slo.upper())
+    if not raw:
+        return None
+    try:
+        ttl = float(raw)
+    except ValueError:
+        return None
+    return ttl if ttl > 0 else None
 
 
 class JobSpec:
@@ -55,16 +84,24 @@ class JobSpec:
                not STARTED proving within its TTL is load-shed with a
                journaled, queryable SHED verdict instead of burning a
                worker on an answer nobody is waiting for.
+      slo      serving class, one of SLO_CLASSES (default "standard"):
+               decides queue precedence (flagship > standard > batch,
+               ahead of the numeric priority), the default deadline
+               (class_default_ttl, overridden by ttl_s), and who sheds
+               first under pressure (lowest class). Excluded from the
+               shape key — a class changes scheduling, never the circuit
+               or the proof bytes.
     """
 
     def __init__(self, kind, params, seed, priority=0, job_key=None,
-                 ttl_s=None):
+                 ttl_s=None, slo=DEFAULT_SLO):
         self.kind = kind
         self.params = params  # shape-determining, seed excluded
         self.seed = seed
         self.priority = priority
         self.job_key = job_key
         self.ttl_s = ttl_s
+        self.slo = slo
 
     @classmethod
     def from_wire(cls, obj):
@@ -88,6 +125,10 @@ class JobSpec:
             if not isinstance(ttl_s, (int, float)) or not ttl_s > 0:
                 raise ValueError("ttl_s must be a positive number")
             ttl_s = float(ttl_s)
+        slo = obj.get("slo", DEFAULT_SLO)
+        if slo not in SLO_CLASSES:
+            raise ValueError(
+                f"slo must be one of {SLO_CLASSES} (got {slo!r})")
         if kind == "toy":
             gates = obj.get("gates")
             if not isinstance(gates, int) or not 1 <= gates <= 1 << 16:
@@ -108,7 +149,7 @@ class JobSpec:
             params = {"height": height, "num_proofs": num_proofs,
                       "num_leaves": num_leaves}
         return cls(kind, params, seed, priority, job_key=job_key,
-                   ttl_s=ttl_s)
+                   ttl_s=ttl_s, slo=slo)
 
     def to_wire(self):
         out = {"kind": self.kind, "seed": self.seed,
@@ -117,6 +158,10 @@ class JobSpec:
             out["job_key"] = self.job_key
         if self.ttl_s is not None:
             out["ttl_s"] = self.ttl_s
+        # omitted when standard: a classless client round-trips to the
+        # byte-identical wire dict it sent (pre-class servers also parse)
+        if self.slo != DEFAULT_SLO:
+            out["slo"] = self.slo
         out.update(self.params)
         return out
 
@@ -201,11 +246,15 @@ class Job:
         self.shape_key = shape_key(spec)
         self.priority = spec.priority
         self.job_key = spec.job_key
+        self.slo = getattr(spec, "slo", DEFAULT_SLO)
+        self.slo_rank = SLO_RANK.get(self.slo, SLO_RANK[DEFAULT_SLO])
         # wall clock, not monotonic: the deadline must survive a service
         # restart (the journal carries it; a recovered job whose TTL
-        # expired during the outage is shed, not resumed)
-        self.deadline_ts = (time.time() + spec.ttl_s
-                            if spec.ttl_s is not None else None)
+        # expired during the outage is shed, not resumed). Explicit
+        # ttl_s wins; otherwise the job's SLO class supplies the default
+        ttl = spec.ttl_s if spec.ttl_s is not None \
+            else class_default_ttl(self.slo)
+        self.deadline_ts = time.time() + ttl if ttl is not None else None
         # every job IS one trace: the id is stamped here (or adopted from
         # the client's trace_ctx by the frontend), handed to the prover
         # tracer, and addresses the merged-timeline artifact trace:<id>
@@ -288,6 +337,7 @@ class Job:
             "spec": self.spec.to_wire(),
             "shape_key": [str(p) for p in self.shape_key],
             "priority": self.priority,
+            "slo": self.slo,
             "job_key": self.job_key,
             "deadline_ts": self.deadline_ts,
             "retries": self.retries,

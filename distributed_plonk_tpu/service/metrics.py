@@ -263,6 +263,47 @@ ISSUE 15):
                                              persisted by the service
     profile_errors                           captures that failed or came
                                              back empty/unsupported
+
+Autoscaling & SLO-class vocabulary (service/autoscale.py,
+service/queue.py, service/pool.py, runtime/supervisor.py — the
+closed-loop controller, ISSUE 16):
+    autoscale_*                              controller activity:
+                                             autoscale_ticks (control-
+                                             loop cycles), autoscale_
+                                             decisions (recorded
+                                             verdicts), autoscale_scale_
+                                             ups / autoscale_scale_downs
+                                             (worker-count moves
+                                             APPLIED), autoscale_lease_
+                                             resizes (submesh capacity
+                                             moves), autoscale_sheds
+                                             (pressure evictions),
+                                             autoscale_sensor_errors;
+                                             gauges autoscale_workers /
+                                             autoscale_target_workers /
+                                             autoscale_queue_<class>
+                                             (per-class queued depth at
+                                             last tick)
+    slo_*                                    per-class serving outcomes:
+                                             slo_roundtrip/<class>
+                                             (histogram: submit -> done
+                                             seconds per SLO class; the
+                                             standard-class p95_s is the
+                                             controller's latency
+                                             sensor), slo_sheds_<class>
+                                             (terminal SHED verdicts per
+                                             class), slo_preempt_sheds
+                                             (lower-class jobs evicted
+                                             by a full queue admitting a
+                                             higher class)
+    worker_retires                           supervised workers retired
+                                             gracefully by scale-down:
+                                             drain -> membership LEAVE
+                                             -> SIGTERM (SIGKILL only
+                                             past DPT_SUP_RETIRE_
+                                             TIMEOUT_S); a retire is
+                                             never a flap and never
+                                             respawns
 """
 
 import math
@@ -325,6 +366,7 @@ class Histogram:
             "mean_s": round(self.sum / self.count, 6),
             "p50_s": round(pct(0.50), 6),
             "p90_s": round(pct(0.90), 6),
+            "p95_s": round(pct(0.95), 6),
             "p99_s": round(pct(0.99), 6),
             "max_s": round(self.max, 6),
         }
@@ -436,7 +478,7 @@ class Metrics:
             n = _prom_name(name) + "_seconds"
             lines.append(f"# TYPE {n} summary")
             for q, key in (("0.5", "p50_s"), ("0.9", "p90_s"),
-                           ("0.99", "p99_s")):
+                           ("0.95", "p95_s"), ("0.99", "p99_s")):
                 lines.append(f'{n}{{quantile="{q}"}} {h[key]}')
             lines.append(f"{n}_sum {h['sum_s']}")
             lines.append(f"{n}_count {h['count']}")

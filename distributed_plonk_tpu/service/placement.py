@@ -96,14 +96,57 @@ class SubmeshLeaser:
         self._all = list(devices)
         self._index = {id(d): i for i, d in enumerate(self._all)}
         self._free = list(self._all)
+        # capacity resize (the autoscaler's batch-vs-flagship actuator):
+        # devices past the capacity are held in _reserved instead of the
+        # free list. Shrinking NEVER revokes a granted lease — it only
+        # withholds free devices; a release past capacity parks the
+        # devices in _reserved until capacity grows again.
+        self._capacity = len(self._all)
+        self._reserved = []
         self._cond = threading.Condition()
 
     def total(self):
         return len(self._all)
 
+    def capacity(self):
+        with self._cond:
+            return self._capacity
+
     def free_count(self):
         with self._cond:
             return len(self._free)
+
+    def set_capacity(self, n):
+        """Resize the leasable pool to n devices (clamped to [1, total]).
+        Grow returns reserved devices to the free list immediately;
+        shrink withholds FREE devices only (highest enumeration index
+        first, preserving low-index contiguity for submesh runs) —
+        granted leases are never revoked, the book just stops re-issuing
+        their devices as they release. Returns the applied capacity."""
+        with self._cond:
+            n = max(1, min(int(n), len(self._all)))
+            self._capacity = n
+            self._rebalance_locked()
+            self._cond.notify_all()
+            return self._capacity
+
+    def _rebalance_locked(self):
+        """Move devices between _free and _reserved to honor _capacity.
+        Outstanding (leased) devices count against capacity, so the
+        invariant is: len(free) + len(reserved) + leased == total, with
+        free allowed up to capacity - leased."""
+        leased = len(self._all) - len(self._free) - len(self._reserved)
+        allowed_free = max(0, self._capacity - leased)
+        if len(self._free) > allowed_free:
+            # withhold highest-index devices first: contiguous low-index
+            # runs (what _grab_locked prefers) survive the shrink
+            self._free.sort(key=lambda d: self._index[id(d)])
+            while len(self._free) > allowed_free:
+                self._reserved.append(self._free.pop())
+        elif len(self._free) < allowed_free and self._reserved:
+            self._reserved.sort(key=lambda d: self._index[id(d)])
+            while len(self._free) < allowed_free and self._reserved:
+                self._free.append(self._reserved.pop(0))
 
     def _grab_locked(self, k):
         """Best contiguous run of k free devices (by original index);
@@ -125,9 +168,9 @@ class SubmeshLeaser:
         """Lease k devices. timeout_s=None blocks until available;
         timeout_s=0 is the opportunistic probe (None when the pool
         cannot satisfy it right now). k is clamped to the pool size."""
-        k = max(1, min(k, len(self._all)))
         deadline = None
         with self._cond:
+            k = max(1, min(k, self._capacity))
             while len(self._free) < k:
                 if timeout_s is not None and timeout_s <= 0:
                     return None
@@ -150,6 +193,9 @@ class SubmeshLeaser:
                 return
             lease._released = True
             self._free.extend(lease.devices)
+            # a release after a shrink may overfill the free list;
+            # rebalance parks the excess in _reserved
+            self._rebalance_locked()
             self._cond.notify_all()
 
 

@@ -388,8 +388,10 @@ class WorkerPool:
         (expired before key build) and the pool loop (expired in the
         dispatch buffer)."""
         self.metrics.inc("jobs_shed")
+        self.metrics.inc("slo_sheds_%s" % getattr(job, "slo", "standard"))
         olog.emit("service", "shed", level="warn", job_id=job.id,
-                  trace_id=job.trace_id, reason=reason)
+                  trace_id=job.trace_id, reason=reason,
+                  slo=getattr(job, "slo", "standard"))
         if self.journal is not None:
             self.journal.append(JN.SHED, job.id, reason=reason)
         self._clear_ckpt(job)
@@ -670,6 +672,11 @@ class WorkerPool:
         self._journal_done(job, proof_bytes, pub)
         self._store_trace(job, tracer)
         job.finish_ok(proof_bytes, pub, totals)
+        # per-SLO-class roundtrip (submit -> served): the standard-class
+        # p95_s of this histogram is the autoscaler's latency sensor
+        self.metrics.observe(
+            "slo_roundtrip/%s" % getattr(job, "slo", "standard"),
+            time.monotonic() - job.submitted_at)
 
     def _should_self_verify(self, job, backend=None):
         if self.verify_on_complete:

@@ -3,12 +3,22 @@
 Admission is decided AT SUBMIT TIME, synchronously, so a client always
 learns immediately whether its job is queued or why not (`Rejected.reason`)
 — the queue never grows past `max_depth` and never silently drops work.
-Within the queue, higher `priority` wins; FIFO within a priority class
-(stable sequence numbers, no starvation among equals).
+Ordering is (SLO class, priority, FIFO): flagship pops before standard
+before batch (jobs.SLO_RANK), higher numeric `priority` wins within a
+class, and stable sequence numbers keep FIFO among equals (no
+starvation). Jobs without a class rank as `standard`, so an all-standard
+stream — every pre-class caller — sorts exactly as the old
+(priority, seq) key did.
 
 `pop_batch` is the scheduler's accessor: it returns the best job AND every
 other queued job sharing its shape key (up to `max_batch`), so one bucket's
 SRS/proving key build is amortized over the whole compatible batch.
+
+`steal_lowest` is the pressure valve: admission (a full queue refusing a
+higher-class job) and the autoscaler both evict the WORST queued job of a
+strictly lower class through it — shed-lowest-class-first, per-class TTL
+defaults (`DPT_TTL_<CLASS>_S`, resolved by jobs.Job at submit) doing the
+slow-path equivalent for jobs nobody pops in time.
 """
 
 import threading
@@ -36,6 +46,17 @@ class JobQueue:
         with self._lock:
             return len(self._items)
 
+    def depth_by_class(self):
+        """{slo_class: queued count} — the autoscaler's class-mix sensor
+        and the console's per-class depth row. Classless jobs count as
+        standard."""
+        with self._lock:
+            out = {}
+            for _key, job in self._items:
+                cls = getattr(job, "slo", "standard")
+                out[cls] = out.get(cls, 0) + 1
+            return out
+
     def submit(self, job, force=False):
         """Enqueue or raise Rejected (queue_full | draining). force=True
         bypasses the depth cap — journal recovery re-enqueues every job
@@ -47,8 +68,11 @@ class JobQueue:
             if not force and len(self._items) >= self.max_depth:
                 raise Rejected("queue_full")
             self._seq += 1
-            # negative priority first => higher priority pops first
-            self._items.append(((-job.priority, self._seq), job))
+            # higher SLO class first, then higher priority, then FIFO;
+            # classless jobs rank standard, which keeps an all-standard
+            # stream's order identical to the historical (priority, seq)
+            self._items.append(((-getattr(job, "slo_rank", 1),
+                                 -job.priority, self._seq), job))
             self.high_water = max(self.high_water, len(self._items))
             self._nonempty.notify()
 
@@ -70,6 +94,27 @@ class JobQueue:
                     rest.append(kv)
             self._items = rest
             return batch
+
+    def steal_lowest(self, below_rank):
+        """Remove and return the WORST queued job of SLO rank strictly
+        below `below_rank` (lowest class, then lowest priority, then
+        newest), or None when nothing qualifies. Shed-lowest-class-first:
+        the caller owns the returned job's terminal SHED verdict
+        (pool.shed journals it) — the queue only picks the victim. With
+        `below_rank` <= the lowest queued rank this is a no-op, so a
+        classless deployment can never preempt anything."""
+        with self._lock:
+            worst = None
+            for i, (key, job) in enumerate(self._items):
+                if getattr(job, "slo_rank", 1) >= below_rank:
+                    continue
+                # sort keys order best-first, so the largest key is the
+                # worst victim candidate
+                if worst is None or key > self._items[worst][0]:
+                    worst = i
+            if worst is None:
+                return None
+            return self._items.pop(worst)[1]
 
     def closed(self):
         """True once close() ran (draining) — /healthz reports it."""

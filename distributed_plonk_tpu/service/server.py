@@ -118,6 +118,9 @@ class ProofService:
         # /fleet endpoint; profile captures land under profile:<id>
         self.fleet = None
         self.fleet_dispatcher = None
+        # closed-loop autoscaler (service/autoscale.py): attach_autoscaler
+        # arms it per DPT_AUTOSCALE; None is the off-mode bit-parity state
+        self.autoscaler = None
         self._profiles = {}  # storeless fallback: id -> (meta, blob)
         # structured logs (obs/log.py) publish their counters into this
         # registry (per-process buffer; last-constructed service wins,
@@ -175,6 +178,18 @@ class ProofService:
         if start:
             self.fleet.start()
         return self
+
+    def attach_autoscaler(self, supervisor=None, mode=None, **kw):
+        """Arm the closed-loop autoscaler (service/autoscale.py) per
+        DPT_AUTOSCALE: "0" (the default) attaches NOTHING and returns
+        None — bit-parity with the pre-autoscaler tree; "dry" runs the
+        control loop and logs/counts decisions without one actuator
+        call; "1" actuates (supervisor add_slot / retire_slot, submesh
+        lease resize, pressure sheds). Pass the WorkerSupervisor that
+        owns the fleet's worker processes to enable worker scaling;
+        without one the controller still resizes leases and sheds."""
+        from . import autoscale as AS
+        return AS.attach(self, supervisor=supervisor, mode=mode, **kw)
 
     def profile_fleet_worker(self, worker=0, duration_ms=None,
                              kind="auto"):
@@ -269,6 +284,27 @@ class ProofService:
             try:
                 self.queue.submit(job)
             except Rejected as e:
+                # shed-lowest-class-first admission: a FULL queue refusing
+                # a higher-SLO-class job first tries to evict the worst
+                # queued job of a strictly lower class (journaled SHED)
+                # and admit the newcomer in its place. An all-standard
+                # stream can never preempt (no lower rank exists), so the
+                # classless path keeps the historical plain rejection.
+                if e.reason == "queue_full":
+                    victim = self.queue.steal_lowest(job.slo_rank)
+                    if victim is not None:
+                        self.metrics.inc("slo_preempt_sheds")
+                        self.pool.shed(
+                            victim,
+                            f"preempted by {job.slo}-class admission")
+                        # force: we hold _submit_lock, and the victim's
+                        # slot was freed this instant — bouncing on a
+                        # racing scheduler pop would lose the preemption
+                        self.queue.submit(job, force=True)
+                        self.metrics.inc("jobs_accepted")
+                        self.metrics.gauge("queue_depth",
+                                           self.queue.depth())
+                        return job, False
                 self.metrics.inc("jobs_rejected")
                 if self.journal is not None:
                     # terminal verdict so replay never resurrects a job
@@ -493,6 +529,8 @@ class ProofService:
     def shutdown(self):
         self.scheduler.stop()
         self.pool.shutdown()
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         if self.fleet is not None:
             self.fleet.close()
         if self._listener is not None:
@@ -517,6 +555,8 @@ class ProofService:
         clean = self.pool.drain(deadline)
         self.metrics.inc("drain_clean" if clean else "drain_forced")
         olog.emit("service", "drain", clean=bool(clean))
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         if self.fleet is not None:
             self.fleet.close()
         if self._listener is not None:
@@ -539,6 +579,8 @@ class ProofService:
         self.queue.close()
         self.scheduler.crash()
         self.pool.crash()
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         if self.fleet is not None:
             self.fleet.close()
         if self._listener is not None:
@@ -756,6 +798,10 @@ class ObsServer:
         /fleet           JSON snapshot: roster with per-member breaker/
                          suspect state and each member's full metrics
                          snapshot (the scripts/console.py data source)
+        /autoscale       the closed-loop controller's state (mode,
+                         bounds/targets, streaks, cooldowns, per-class
+                         queue depth, last decisions); 404 while
+                         DPT_AUTOSCALE=0 / unattached
         /logs            this process's structured-log ring (obs/log.py);
                          ?trace_id=&since_seq=&limit= filter/tail
         /trace/<job_id>  the job's merged timeline as Chrome trace-event
@@ -865,6 +911,13 @@ def _obs_route(svc, path):
             "draining": svc.queue.closed(),
         })
         return 200, "application/json", protocol.encode_json(out)
+    if path == "/autoscale":
+        asc = getattr(svc, "autoscaler", None)
+        if asc is None:
+            return 404, "application/json", protocol.encode_json(
+                {"error": "autoscaler off (DPT_AUTOSCALE=dry|1 and "
+                          "ProofService.attach_autoscaler)"})
+        return 200, "application/json", protocol.encode_json(asc.state())
     if path == "/logs":
         q = _query_params(query)
         out = olog.fetch(trace_id=q.get("trace_id") or None,
@@ -904,6 +957,6 @@ def _obs_route(svc, path):
             protocol.encode_json(to_chrome_trace(merged))
     return 404, "application/json", protocol.encode_json(
         {"error": f"unknown path {path!r}",
-         "endpoints": ["/metrics", "/healthz", "/fleet", "/logs",
-                       "/trace/<job_id>", "/profile/<id>",
+         "endpoints": ["/metrics", "/healthz", "/fleet", "/autoscale",
+                       "/logs", "/trace/<job_id>", "/profile/<id>",
                        "/profile/capture"]})

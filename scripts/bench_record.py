@@ -148,6 +148,7 @@ WATCH = [
     ("batch_prove_byte_identical", ("true", 0)),
     ("self_verify_bytes_identical", ("true", 0)),
     ("trace_ctx_adopted", ("true", 0)),
+    ("autoscale_canary_ok", ("true", 0)),
     # serving throughput + kernel A/Bs (ratios are basis-stable)
     ("proofs_per_s", ("higher", 0.5)),
     ("batch_prove_speedup_vs_sequential", ("higher", 0.4)),
@@ -164,6 +165,7 @@ WATCH = [
     ("fleet_chaos_s", ("lower", 1.5)),
     ("self_verify_overhead_pct", ("lower", 1.0)),
     ("service_roundtrip_warm_s", ("lower", 1.5)),
+    ("slo_p95_standard_s", ("lower", 1.5)),
     ("headline/prove_2p13_wall_clock", ("lower", 0.5)),
     ("headline/*_throughput", ("higher", 0.5)),
 ]

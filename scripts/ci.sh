@@ -56,7 +56,14 @@
 #                          PLUS the placement suite: batched-vs-sequential
 #                          byte-identity, submesh lease/release, batch
 #                          member kill-resume, mesh-retry re-placement,
-#                          DPT_BATCH_PROVE=0 parity
+#                          DPT_BATCH_PROVE=0 parity, PLUS the closed-loop
+#                          autoscaling suite (ISSUE 16): control-law
+#                          hysteresis/cooldown/bounds units, SLO-class
+#                          queue ordering + per-class TTLs, dry-run
+#                          zero-actuator-calls pin, DPT_AUTOSCALE=0
+#                          parity, graceful retire (drain-then-LEAVE),
+#                          and the live supervised-fleet scale-up/
+#                          retire canary (every proof byte-verified)
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
@@ -74,7 +81,7 @@ if [ "$1" = "chaos" ]; then
     tests/test_integrity.py \
     tests/test_service_journal.py \
     tests/test_trace.py tests/test_obs.py tests/test_fleet_obs.py \
-    tests/test_placement.py \
+    tests/test_placement.py tests/test_autoscale.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "autotune" ]; then

@@ -10,7 +10,9 @@ any ObsServer): service readiness (queue depth, busy workers, draining),
 the membership summary (epoch, width, suspects, open breakers), and one
 row per fleet member — reachability, breaker/suspect state, served
 request counters, live kernel gflops/MFU gauges, injected-SDC count —
-plus an optional tail of the structured log ring (/logs). Plain ANSI,
+plus the /autoscale controller pane (targets, per-class queue depth,
+last 5 decisions; one quiet '(off)' line when DPT_AUTOSCALE=0) and an
+optional tail of the structured log ring (/logs). Plain ANSI,
 no curses: works over any ssh session, and --once makes it scriptable
 (the loadgen soak and tests use it as the "can an operator actually see
 the fleet" check)."""
@@ -46,6 +48,38 @@ def _fmt_member(m):
             f"gflops({kernels or '-'})")
 
 
+def _autoscale_pane(base):
+    """Controller pane: targets, per-class queue depth, the last 5
+    decisions. A 404 (DPT_AUTOSCALE=0 / unattached) renders as one
+    quiet '(off)' line so the console works against any daemon."""
+    try:
+        a = _get(base, "/autoscale")
+    except Exception:
+        return ["autoscale (off)"]
+    b, t, cd = a.get("bounds") or {}, a.get("targets") or {}, \
+        a.get("cooldowns") or {}
+    st = a.get("streaks") or {}
+    lines = [
+        f"autoscale mode={a.get('mode')} workers={a.get('workers')} "
+        f"bounds={b.get('min_workers')}..{b.get('max_workers')} "
+        f"up@{t.get('up_queue_per_worker')}/worker "
+        f"p95_slo={t.get('slo_p95_standard_s')} "
+        f"streak(up={st.get('up')},down={st.get('down')}) "
+        f"cooldown(up={cd.get('up_remaining_s')}s,"
+        f"down={cd.get('down_remaining_s')}s)"]
+    q = a.get("queue") or {}
+    by = q.get("by_class") or {}
+    lines.append("  queue  depth=%s  %s" % (
+        q.get("depth"),
+        " ".join(f"{c}={by.get(c, 0)}"
+                 for c in ("flagship", "standard", "batch"))))
+    for d in (a.get("last_decisions") or [])[-5:]:
+        ts = time.strftime("%H:%M:%S", time.localtime(d.get("ts", 0)))
+        lines.append(f"  {ts} [{d.get('action')}] "
+                     f"applied={d.get('applied')} {d.get('reason', '')}")
+    return lines
+
+
 def render(base, log_tail=0):
     lines = []
     h = _get(base, "/healthz")
@@ -66,6 +100,7 @@ def render(base, log_tail=0):
             lines.append(f"  (no /fleet snapshot: {e})")
     else:
         lines.append("fleet    (none attached)")
+    lines.extend(_autoscale_pane(base))
     if log_tail:
         try:
             lg = _get(base, f"/logs?limit={log_tail}")

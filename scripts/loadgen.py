@@ -6,6 +6,12 @@
     python scripts/loadgen.py --jobs 12 --no-kill
     python scripts/loadgen.py --kill-rate 0.5 --corrupt-rate 0.3 \
         --delay-ms 5 --store-dir /tmp/s                    # chaos soak
+    DPT_AUTOSCALE=1 python scripts/loadgen.py --traffic diurnal \
+        --slo-mix flagship=0.1,standard=0.6,batch=0.3      # autoscaling
+        # soak: seeded diurnal arrival curve against a supervised fleet,
+        # the closed-loop controller must ramp workers up into the peak
+        # and retire them (drain-then-LEAVE) after it — every proof
+        # byte-verified, zero flagship sheds
     python scripts/loadgen.py --kill-service ROUND2        # restart soak:
         # spawns scripts/serve.py as a real subprocess (journal + store),
         # submits the job mix with idempotency keys, SIGKILLs the SERVICE
@@ -85,6 +91,296 @@ def _proof_reference(spec, _pk_cache={}):
         _pk_cache[key] = build_bucket_keys(s)[1]
     return serialize_proof(prove(_random.Random(s.seed), build_circuit(s),
                                  _pk_cache[key], PythonBackend()))
+
+
+def _parse_slo_mix(arg):
+    """'flagship=0.1,standard=0.6,batch=0.3' -> {class: weight}, failing
+    fast with a message that names the flag. Weights need not sum to 1
+    (they are normalized at draw time); unknown classes are an error."""
+    from distributed_plonk_tpu.service.jobs import SLO_CLASSES
+    mix = {}
+    for entry in arg.split(","):
+        name, sep, w = entry.strip().partition("=")
+        if not sep or name not in SLO_CLASSES:
+            raise SystemExit(f"--slo-mix: {entry.strip()!r} is not "
+                             f"<class>=<weight> with class in "
+                             f"{SLO_CLASSES}")
+        try:
+            mix[name] = float(w)
+        except ValueError:
+            raise SystemExit(f"--slo-mix: {w!r} is not a number")
+    if not mix or sum(mix.values()) <= 0:
+        raise SystemExit("--slo-mix: needs at least one positive weight")
+    return mix
+
+
+def _traffic_schedule(model, jobs, duration_s, seed, slo_mix):
+    """[(arrival_offset_s, slo_class)] for `jobs` arrivals over
+    `duration_s` seconds under a DETERMINISTIC rate curve — inverse-CDF
+    sampling of evenly spaced quantiles over a 512-point grid, so the
+    same (model, jobs, duration, seed) always produces the same
+    schedule (the soak is replayable). Curves (t in [0,1)):
+
+        flat     1.0
+        diurnal  0.15 + 0.85*sin(pi*t)^2   — one day compressed: quiet
+                 shoulders, one mid-window peak (the autoscaler must
+                 ramp up into it and back down after)
+        burst    0.12 off-peak, 1.0 inside [0.40, 0.60] — a step spike
+
+    SLO classes are drawn per arrival from the seeded rng against the
+    normalized `slo_mix` weights."""
+    import bisect
+    import math
+    rng = random.Random(seed)
+    grid = 512
+
+    def rate(t):
+        if model == "diurnal":
+            return 0.15 + 0.85 * math.sin(math.pi * t) ** 2
+        if model == "burst":
+            return 1.0 if 0.40 <= t <= 0.60 else 0.12
+        return 1.0
+
+    cum = [0.0]
+    for g in range(grid):
+        cum.append(cum[-1] + rate((g + 0.5) / grid))
+    total = cum[-1]
+    classes = sorted(slo_mix)
+    wsum = sum(slo_mix[c] for c in classes)
+    out = []
+    for i in range(jobs):
+        target = (i + 0.5) / jobs * total
+        g = bisect.bisect_left(cum, target)
+        g = min(max(g, 1), grid)
+        frac = (g - 1 + (target - cum[g - 1]) / (cum[g] - cum[g - 1])) \
+            / grid
+        r = rng.random() * wsum
+        acc, cls = 0.0, classes[-1]
+        for c in classes:
+            acc += slo_mix[c]
+            if r < acc:
+                cls = c
+                break
+        out.append((round(frac * duration_s, 4), cls))
+    return out
+
+
+# per-class job shapes for the traffic soak: interactive classes are
+# small (flagship n=32 proves in well under a tick), batch is the big
+# one (n=512) — the mix that actually moves the per-class queue depths
+# the lease-resize rule watches
+_SLO_GATES = {"flagship": 16, "standard": 60, "batch": 150}
+
+
+def run_traffic_soak(args):
+    """--traffic: the closed-loop autoscaling acceptance soak (ISSUE 16).
+    A supervised fleet starts at ONE worker behind a fleet-backed proof
+    service with the autoscaler attached per DPT_AUTOSCALE (or
+    --autoscale); a seeded arrival-rate curve (diurnal/burst/flat) with
+    an SLO-class mix is replayed against it in real time. The controller
+    must scale UP into the ramp (supervisor.add_slot — warm membership
+    join), back DOWN after the peak (retire_slot: drain, LEAVE, SIGTERM
+    — never a mid-prove kill), and EVERY served proof must be
+    byte-identical to a local uninterrupted prove. The summary carries
+    per-class latency percentiles + shed counts (`slo`) and the
+    controller's decision trail (`autoscale`); --record appends it to
+    bench_artifacts/trajectory.jsonl via scripts/bench_record.py."""
+    from distributed_plonk_tpu.runtime.dispatcher import (Dispatcher,
+                                                          RemoteBackend)
+    from distributed_plonk_tpu.runtime.health import LivenessTracker
+    from distributed_plonk_tpu.runtime.netconfig import NetworkConfig
+    from distributed_plonk_tpu.runtime.supervisor import WorkerSupervisor
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+    from distributed_plonk_tpu.service import autoscale as AS
+
+    # control-loop knobs scaled to a CI-sized soak (a set env wins)
+    for k, v in (("DPT_AUTOSCALE_TICK_S", "0.5"),
+                 ("DPT_AS_MIN_WORKERS", "1"),
+                 ("DPT_AS_MAX_WORKERS", "3"),
+                 ("DPT_AS_UP_QUEUE", "2"),
+                 ("DPT_AS_UP_TICKS", "2"),
+                 ("DPT_AS_DOWN_TICKS", "4"),
+                 ("DPT_AS_UP_COOLDOWN_S", "3"),
+                 ("DPT_AS_DOWN_COOLDOWN_S", "5"),
+                 ("DPT_SUP_RETIRE_TIMEOUT_S", "10")):
+        os.environ.setdefault(k, v)
+    if args.autoscale is not None:
+        os.environ["DPT_AUTOSCALE"] = args.autoscale
+    mode = AS.mode_from_env()
+
+    from distributed_plonk_tpu.service.metrics import Metrics
+    t0 = time.time()
+    slo_mix = _parse_slo_mix(args.slo_mix)
+    schedule = _traffic_schedule(args.traffic, args.jobs, args.duration,
+                                 args.chaos_seed, slo_mix)
+
+    fm = Metrics()  # fleet-side registry: supervisor/membership counters
+    d = Dispatcher(NetworkConfig([]), metrics=fm)
+    d.tracker = LivenessTracker(0, breaker_k=2, probe_base_s=0.05,
+                                probe_max_s=0.5, metrics=fm)
+    mserver = d.enable_membership()
+
+    def spawn_cmd(i, slot):
+        return [sys.executable, "-m",
+                "distributed_plonk_tpu.runtime.worker",
+                "--join", f"127.0.0.1:{mserver.port}",
+                "--listen", f"127.0.0.1:{slot.port}",
+                "--backend", "python"]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sup = WorkerSupervisor("127.0.0.1", mserver.port, n=1, metrics=fm,
+                           cwd=repo, spawn_cmd=spawn_cmd).start()
+    sup.attach_registry(d.membership)
+    svc = None
+    results = []
+    results_lock = threading.Lock()
+    asc_state = None
+    svc_metrics = {"counters": {}, "gauges": {}, "histograms": {}}
+    try:
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            if d.workers and d.tracker.usable_set():
+                break
+            time.sleep(0.1)
+        # fleet-backed service: one pool worker drives the one dispatcher
+        # (queue depth is the up-signal; the fleet widens the FFT shards)
+        svc = ProofService(
+            port=0, prover_workers=1, chaos=True, max_retries=4,
+            allow_remote_shutdown=True, self_verify="1",
+            backend_factory=lambda: RemoteBackend(d, dist_fft_min=64),
+        ).start()
+        svc.attach_autoscaler(supervisor=sup)
+
+        start = time.monotonic()
+
+        def submitter(i, at_s, cls):
+            out = {"index": i, "slo": cls}
+            delay = start + at_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            spec = {"kind": "toy", "gates": _SLO_GATES[cls],
+                    "seed": 5000 + i, "slo": cls}
+            out["spec"] = spec
+            t_sub = time.monotonic()
+            try:
+                with ServiceClient("127.0.0.1", svc.port) as c:
+                    out["job_id"] = c.submit(spec)["job_id"]
+                    st = c.wait(out["job_id"], timeout_s=args.timeout)
+                    out["state"] = st["state"]
+                    out["roundtrip_s"] = round(time.monotonic() - t_sub, 4)
+                    if st["state"] == "done":
+                        _hdr, blob = c.result(out["job_id"])
+                        out["verified"] = blob == _proof_reference(spec)
+                    elif st["state"] != "shed":
+                        out["error"] = st.get("error")
+            except Exception as e:  # noqa: BLE001 - report, don't crash
+                out["error"] = repr(e)
+            with results_lock:
+                results.append(out)
+
+        threads = [threading.Thread(target=submitter, args=(i, at, cls),
+                                    daemon=True)
+                   for i, (at, cls) in enumerate(schedule)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=args.timeout + args.duration)
+        # post-peak idle window: hold the (now-idle) service open long
+        # enough for the down streak + cooldown to elapse, so the soak
+        # demonstrates BOTH transitions — not just the ramp-up
+        if mode == "1":
+            idle_deadline = time.monotonic() + 30
+            while time.monotonic() < idle_deadline:
+                sc = svc.metrics.snapshot()["counters"]
+                if sc.get("autoscale_scale_downs", 0) >= 1:
+                    break
+                time.sleep(0.25)
+        if svc.autoscaler is not None:
+            asc_state = svc.autoscaler.state()
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            svc_metrics = c.metrics()
+            c.shutdown_server()
+    finally:
+        sup.stop()
+        try:
+            d.shutdown()
+        finally:
+            d.pool.shutdown(wait=False)
+        if svc is not None:
+            svc.shutdown()
+
+    sc = svc_metrics["counters"]
+    fc = fm.snapshot()["counters"]
+    per_class = {}
+    for cls in ("flagship", "standard", "batch"):
+        rs = [r for r in results if r["slo"] == cls]
+        rts = sorted(r["roundtrip_s"] for r in rs
+                     if r.get("state") == "done"
+                     and r.get("roundtrip_s") is not None)
+
+        def pct(p, rts=rts):
+            if not rts:
+                return None
+            return round(rts[min(len(rts) - 1, int(p * len(rts)))], 4)
+
+        per_class[cls] = {
+            "submitted": len(rs),
+            "done": sum(1 for r in rs if r.get("state") == "done"),
+            "shed": sc.get(f"slo_sheds_{cls}", 0),
+            "verified": sum(1 for r in rs if r.get("verified")),
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+        }
+    done = sum(1 for r in results if r.get("state") == "done")
+    verified = sum(1 for r in results if r.get("verified"))
+    shed = sum(1 for r in results if r.get("state") == "shed")
+    # the contract: every proof SERVED verified byte-identical, every
+    # job accounted for (done or shed, nothing stuck), and load shedding
+    # never touched the flagship class
+    ok = (verified == done and done + shed == args.jobs
+          and per_class["flagship"]["shed"] == 0)
+    scale_ups = sc.get("autoscale_scale_ups", 0)
+    scale_downs = sc.get("autoscale_scale_downs", 0)
+    if mode == "1":
+        # actuating acceptance: the controller visibly rode the curve
+        ok = ok and scale_ups >= 1 and scale_downs >= 1
+    summary = {
+        "mode": "traffic", "ok": ok,
+        "traffic": args.traffic, "autoscale_mode": mode,
+        "wall_s": round(time.time() - t0, 3),
+        "jobs": args.jobs, "duration_s": args.duration,
+        "slo_mix": slo_mix,
+        "verified": verified,
+        "unverified_served": done - verified,
+        "failed": [r for r in results
+                   if not r.get("verified") and r.get("state") != "shed"],
+        "slo": per_class,
+        "autoscale": {
+            "mode": mode,
+            "ticks": sc.get("autoscale_ticks", 0),
+            "decisions": sc.get("autoscale_decisions", 0),
+            "scale_ups": scale_ups,
+            "scale_downs": scale_downs,
+            "lease_resizes": sc.get("autoscale_lease_resizes", 0),
+            "sheds": sc.get("autoscale_sheds", 0),
+            "actuator_errors": sc.get("autoscale_actuator_errors", 0),
+            "worker_retires": fc.get("worker_retires", 0),
+            # zero mid-prove kills: a retire is not a flap/respawn
+            "worker_respawns": fc.get("worker_respawns", 0),
+            "worker_flap_capped": fc.get("worker_flap_capped", 0),
+            "final_state": asc_state,
+        },
+    }
+    if args.record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        import bench_record
+        rec = bench_record.normalize(
+            "loadgen", dict(summary, backend="python"))
+        summary["recorded"] = bench_record.append(rec, repo=repo)
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
 
 
 def run_kill_service_soak(args):
@@ -417,10 +713,38 @@ def main():
                          "reports detections/quarantines/re-proves and "
                          "the exit code asserts zero unverified proofs "
                          "served")
+    ap.add_argument("--traffic", default=None,
+                    choices=("flat", "diurnal", "burst"),
+                    help="autoscaling soak (ISSUE 16): replay a seeded "
+                         "deterministic arrival-rate curve against a "
+                         "supervised fleet with the closed-loop "
+                         "autoscaler attached per DPT_AUTOSCALE — "
+                         "'diurnal' is one compressed day (quiet "
+                         "shoulders, one peak), 'burst' a step spike, "
+                         "'flat' constant rate; the summary reports "
+                         "per-class latency percentiles + sheds and the "
+                         "controller's decision trail")
+    ap.add_argument("--slo-mix", default="standard=1.0",
+                    metavar="CLS=W,...",
+                    help="SLO-class weights for --traffic arrivals, "
+                         "e.g. flagship=0.1,standard=0.6,batch=0.3 "
+                         "(normalized; drawn per arrival from "
+                         "--chaos-seed)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="--traffic: seconds the arrival curve spans")
+    ap.add_argument("--autoscale", default=None, choices=("0", "dry", "1"),
+                    help="--traffic: override DPT_AUTOSCALE for the soak "
+                         "(default: the environment decides)")
+    ap.add_argument("--record", action="store_true",
+                    help="--traffic: append the summary (basis: "
+                         "host-oracle) to bench_artifacts/"
+                         "trajectory.jsonl via scripts/bench_record.py")
     ap.add_argument("--timeout", type=float, default=600)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.traffic is not None:
+        return run_traffic_soak(args)
     if args.kill_service is not None:
         return run_kill_service_soak(args)
     if args.sdc_rate is not None:

@@ -166,6 +166,12 @@ def main():
     if args.obs_port is not None:
         obs = ObsServer(svc, host=args.host, port=args.obs_port).start()
 
+    # closed-loop autoscaler per DPT_AUTOSCALE (0=off/bit-parity,
+    # dry=recommend-only, 1=actuating). The standalone daemon has no
+    # WorkerSupervisor, so worker scaling records as not-applied; lease
+    # resizes and pressure sheds still actuate in mode 1.
+    autoscaler = svc.attach_autoscaler()
+
     drain_state = {}
 
     def _drain_handler(signum, _frame):
@@ -184,7 +190,8 @@ def main():
                       "workers": args.workers, "chaos": args.chaos,
                       "store": args.store_dir, "journal": journal_dir,
                       "log_file": log_path,
-                      "autotune": svc.autotune}),
+                      "autotune": svc.autotune,
+                      "autoscale": autoscaler.mode if autoscaler else "0"}),
           flush=True)
     svc.serve_forever()
     if obs is not None:

@@ -46,6 +46,7 @@ _HOST_TIER = {
     "test_proof_golden", "test_imports", "test_checkpoint",
     "test_service", "test_store", "test_runtime_faults",
     "test_membership", "test_integrity", "test_fleet_obs",
+    "test_autoscale",
 }
 
 
