@@ -763,6 +763,71 @@ def service_roundtrip_main():
                                "number (ROADMAP sweep)"),
         }
 
+    def aggregate_ab(n_jobs=8):
+        """Batch-KZG aggregation A/B (ISSUE 17): N mixed-kind proofs
+        (toy + range-check shapes) verified one by one — N independent
+        pairing checks — vs folded into ONE aggregate accepted by a
+        single 2-pair pairing check. aggregate_ok pins the whole
+        contract: the fold verifies, the pairing counters read exactly
+        {checks: 1, pairs: 2} regardless of N, and a one-bit proof
+        corruption REBUILT into a consistent aggregate is rejected (the
+        soundness leg, not just artifact tamper-evidence)."""
+        import random as _r
+        from distributed_plonk_tpu import aggregate as AGG
+        from distributed_plonk_tpu import curve
+        from distributed_plonk_tpu.backend.python_backend import \
+            PythonBackend
+        from distributed_plonk_tpu.prover import prove
+        from distributed_plonk_tpu.proof_io import serialize_proof
+        from distributed_plonk_tpu.service.jobs import (build_circuit,
+                                                        shape_key)
+
+        shapes = [{"kind": "toy", "gates": 16},
+                  {"kind": "range", "bits": 8, "count": 2}]
+        keys, vk_cache, members = {}, {}, []
+        be = PythonBackend()
+        for i in range(n_jobs):
+            wire = dict(shapes[i % len(shapes)], seed=8100 + i)
+            s = JobSpec.from_wire(wire)
+            k = shape_key(s)
+            if k not in keys:
+                keys[k] = build_bucket_keys(s)
+            vk_cache[k] = keys[k][2]
+            ckt = build_circuit(s)
+            proof = prove(_r.Random(s.seed), ckt, keys[k][1], be)
+            members.append({"job_id": f"bench-{i}", "spec": s.to_wire(),
+                            "pub": ckt.public_input(),
+                            "proof": serialize_proof(proof)})
+        t0 = time.perf_counter()
+        seq_ok = all(
+            verify(vk_cache[shape_key(JobSpec.from_wire(m["spec"]))],
+                   m["pub"], deserialize_proof(m["proof"]),
+                   rng=_r.Random(1))
+            for m in members)
+        seq_s = time.perf_counter() - t0
+        agg = AGG.build(members)
+        curve.reset_pairing_counters()
+        t0 = time.perf_counter()
+        agg_ok = AGG.verify(agg, vk_cache)
+        agg_s = time.perf_counter() - t0
+        pinned = dict(curve.PAIRING_COUNTERS)
+        bad_members = [dict(m) for m in members]
+        pb = bytearray(bad_members[0]["proof"])
+        pb[len(pb) // 2] ^= 1
+        bad_members[0]["proof"] = bytes(pb)
+        rejected = not AGG.verify(AGG.build(bad_members), vk_cache)
+        ok = (seq_ok and agg_ok and rejected
+              and pinned == {"checks": 1, "pairs": 2})
+        return {
+            "aggregate_ok": bool(ok),
+            "aggregate_verify_speedup_vs_sequential":
+                round(seq_s / agg_s, 3) if agg_s else None,
+            "aggregate_ab_members": n_jobs,
+            "aggregate_ab_sequential_s": round(seq_s, 3),
+            "aggregate_ab_aggregate_s": round(agg_s, 3),
+            "aggregate_pairing_checks": pinned,
+        }
+
     def self_verify_ab(gates=60):
         """In-run verify-before-serve A/B (ISSUE 13): the same toy job
         proved with DPT_SELF_VERIFY=1 (host pairing verifier gating the
@@ -881,6 +946,12 @@ def service_roundtrip_main():
         except Exception as e:  # diagnostic; never fail the canary
             as_canary = {"autoscale_canary_error": repr(e),
                          "autoscale_canary_ok": False}
+        try:
+            agg_ab = aggregate_ab()
+        except Exception as e:  # diagnostic; never fail the canary
+            agg_ab = {"aggregate_ab_error": repr(e),
+                      "aggregate_ok": False,
+                      "aggregate_verify_speedup_vs_sequential": None}
         spec = JobSpec.from_wire(header["spec"])
         vk = build_bucket_keys(spec)[2]
         pub = [int(x, 16) for x in header["public_input"]]
@@ -917,6 +988,9 @@ def service_roundtrip_main():
             **batch_ab,
             # verify-before-serve overhead (the ISSUE 13 in-run A/B)
             **sv_ab,
+            # batch-KZG aggregation (the ISSUE 17 canary): N proofs in,
+            # one 2-pair pairing check out, corrupted member rejected
+            **agg_ab,
             # closed-loop control law (the ISSUE 16 canary): ramp ->
             # scale_up, idle -> scale_down, dry arm pinned at ZERO
             # actuator calls, off arm attaches nothing

@@ -1,0 +1,67 @@
+"""The circuit zoo: real workloads behind `circuit_kind` (ISSUE 17).
+
+Everything the service proved before this package existed was the
+synthetic `_toy_circuit` chain in service/jobs.py plus the Merkle
+workload generator — fine for exercising the prover, useless for
+exercising the SCHEDULER, whose whole job (shape bucketing, cross-job
+batching, placement, SLO classes) only becomes interesting under
+heterogeneous traffic. The zoo is a registry of circuit families built
+on the existing 5-wire/13-selector builder (circuit.PlonkCircuit), each
+obeying the service's one structural contract (service/jobs.py):
+
+    two specs with the same params but different seeds produce circuits
+    with IDENTICAL structure (gates, wiring, selectors) — only witness
+    values and public inputs differ.
+
+That contract is what lets a bucket's SRS + proving key be shared across
+every job in the bucket, so every builder here derives gate COUNT and
+WIRING purely from params, and draws only witness VALUES from the seed.
+
+Kinds (each module exposes validate(obj) -> params and
+build(params, seed) -> finalized, satisfiability-checked circuit):
+
+  range     bit-decomposition range checks: `count` public values each
+            proven to lie in [0, 2^bits) via enforce_bool chains
+  preimage  Rescue-hash preimage knowledge: public digests, private
+            (x, y, z) preimages through hash3_gadget
+  rollup    the flagship shape — a rollup-style state-transition batch:
+            `updates` account-balance updates under one 3-ary Rescue
+            Merkle root, old root and final root public, every
+            intermediate transition proven in-circuit
+
+The pre-existing `toy` and `merkle` kinds stay where they were
+(service/jobs.py, workload.py); the registry here covers only the new
+families, and service/jobs.py routes `circuit_kind` through REGISTRY so
+adding a kind is: write a module, add it to REGISTRY, done — loadgen's
+--circuit-mix and the bucket cache pick it up by name.
+"""
+
+from . import preimage, range_check, rollup
+
+# kind name -> module with validate(obj)->params, build(params, seed)->ckt
+REGISTRY = {
+    "range": range_check,
+    "preimage": preimage,
+    "rollup": rollup,
+}
+
+KINDS = tuple(sorted(REGISTRY))
+
+
+def validate_params(kind, obj):
+    """Untrusted wire dict -> canonical params dict for `kind`.
+    Raises ValueError with a client-presentable reason."""
+    mod = REGISTRY.get(kind)
+    if mod is None:
+        raise ValueError(f"unknown circuit kind {kind!r}")
+    return mod.validate(obj)
+
+
+def build(kind, params, seed):
+    """(kind, params, seed) -> finalized circuit; every builder runs
+    check_satisfiability() before finalize, so a buggy witness generator
+    fails loudly at build time, never as an unverifiable proof."""
+    mod = REGISTRY.get(kind)
+    if mod is None:
+        raise ValueError(f"unknown circuit kind {kind!r}")
+    return mod.build(params, seed)

@@ -332,6 +332,18 @@ def miller_loop(p, q_untwisted):
     return f
 
 
+# pairing-cost accounting: aggregation's whole value proposition is
+# "N proofs, one 2-pair check", so tests pin the claim against these
+# counters instead of trusting the docstring (reset_pairing_counters()
+# then assert checks == 1 and pairs == 2 after verify_aggregate).
+PAIRING_COUNTERS = {"checks": 0, "pairs": 0}
+
+
+def reset_pairing_counters():
+    PAIRING_COUNTERS["checks"] = 0
+    PAIRING_COUNTERS["pairs"] = 0
+
+
 def pairing_check(pairs):
     """Return True iff prod e(P_i, Q_i) == 1.
 
@@ -339,10 +351,12 @@ def pairing_check(pairs):
     exponentiation. This is all the verifier needs (KZG check at
     jf-plonk's verify, reference src/dispatcher2.rs:1290-1293).
     """
+    PAIRING_COUNTERS["checks"] += 1
     f = FQ12_ONE
     for p, q in pairs:
         if p is None or q is None:
             continue
+        PAIRING_COUNTERS["pairs"] += 1
         f = fq12_mul(f, miller_loop(p, _untwist(q)))
     return fq12_pow(f, FINAL_EXP) == FQ12_ONE
 

@@ -43,6 +43,9 @@ two or more spaces:
                  scale_up/scale_down verdicts, lease resizes, pressure
                  sheds, loop start — dry-mode recommendations included
                  (applied=False)
+    aggregate    batch-KZG aggregation verdicts (service/server.py):
+                 aggregates built (members, kinds, build_s), self-verify
+                 rejections, recovery restores/losses
 
 Levels: debug < info < warn < error (no filtering on record — the ring
 is small and the consumer filters; the FILE sink honors DPT_LOG_LEVEL).

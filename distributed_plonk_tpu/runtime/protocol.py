@@ -127,6 +127,21 @@ PROFILE = 35        # JSON {duration_ms?, kind?} -> OK + [u32 hdr][hdr JSON
                     # (format "pystacks-json") otherwise. The caller stores
                     # the blob as a content-addressed profile:<id> artifact
                     # served at /profile/<id>.
+# --- proof aggregation plane (aggregate.py, ISSUE 17) ------------------------
+AGGREGATE = 36      # JSON {job_ids: [...]} -> OK + JSON {agg_id, members,
+                    # kinds, store_key?, digest?, build_s}: fold N DONE
+                    # jobs' proofs into one batch-KZG aggregate artifact
+                    # (aggregate:<agg_id>, journaled like DONE) whose
+                    # verification is ONE 2-pair pairing check regardless
+                    # of N. ERR + JSON {reason, job_id?} when any named
+                    # job is unknown or not DONE — an aggregate over a
+                    # partial batch would silently weaken the client's
+                    # "everything I submitted verified" claim.
+AGG_FETCH = 37      # JSON {agg_id} -> OK + [u32 hdr][hdr JSON {agg_id,
+                    # members, digest}][aggregate JSON blob]: serve a
+                    # built aggregate artifact (from the store when the
+                    # service has one, from the in-memory table
+                    # otherwise; journal recovery restores both paths)
 OK = 100
 ERR = 101
 

@@ -98,6 +98,25 @@ class ServiceClient:
         _hdr, blob = self.store_fetch(f"trace:{job_id}")
         return json.loads(blob.decode())
 
+    def aggregate(self, job_ids):
+        """Fold N DONE jobs into one batch-KZG aggregate on the server.
+        Returns the AGGREGATE reply dict ({agg_id, members, kinds,
+        digest, build_s}); raises ServiceError when any member is
+        unknown or not DONE (the fold is all-or-nothing)."""
+        return protocol.decode_json(
+            self._call(protocol.AGGREGATE,
+                       protocol.encode_json({"job_ids": list(job_ids)})))
+
+    def fetch_aggregate(self, agg_id):
+        """The built aggregate's canonical JSON artifact as a dict —
+        exactly what aggregate.verify() consumes (one 2-pair pairing
+        check for the whole batch). Raises ServiceError on a miss."""
+        from .. import aggregate as AGG
+        _hdr, blob = protocol.decode_result(
+            self._call(protocol.AGG_FETCH,
+                       protocol.encode_json({"agg_id": agg_id})))
+        return AGG.from_bytes(blob)
+
     def kill_worker(self, worker=None, job_id=None, at_round=None):
         req = {}
         if worker is not None:

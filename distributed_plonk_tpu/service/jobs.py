@@ -11,13 +11,20 @@ makes a bucket's SRS + proving key shareable across every job in it
 bucket pk verify under the bucket vk for arbitrary seeds).
 
 Families:
-  toy    {"kind": "toy", "gates": G, "seed": S}
-         add/mul/lc chain, G gates -> domain next_pow2(G + ~4). The
-         small-domain family load tests and tier-1 use.
-  merkle {"kind": "merkle", "height": H, "num_proofs": P,
-          "num_leaves": L?, "seed": S}
-         the paper's Merkle-membership workload (workload.py); structure
-         depends only on (H, P, L) because leaf indices are k % L.
+  toy      {"kind": "toy", "gates": G, "seed": S}
+           add/mul/lc chain, G gates -> domain next_pow2(G + ~4). The
+           small-domain family load tests and tier-1 use.
+  merkle   {"kind": "merkle", "height": H, "num_proofs": P,
+            "num_leaves": L?, "seed": S}
+           the paper's Merkle-membership workload (workload.py); structure
+           depends only on (H, P, L) because leaf indices are k % L.
+  range    {"kind": "range", "bits": B, "count": C?, "seed": S}
+  preimage {"kind": "preimage", "count": C?, "seed": S}
+  rollup   {"kind": "rollup", "height": H, "updates": M?,
+            "num_accounts": A?, "seed": S}
+           the circuit zoo (circuits/ package, ISSUE 17): validation and
+           construction are delegated to circuits.REGISTRY, and every zoo
+           builder honors the same structure-from-params contract.
 
 The SRS uses the repo's fixed test tau, so clients can rebuild the
 matching vk locally with build_bucket_keys() and verify results without a
@@ -33,12 +40,13 @@ import time
 from ..circuit import PlonkCircuit
 from ..constants import R_MOD
 from ..trace import new_trace_id
+from .. import circuits
 
 # same deterministic toxic-waste tau as tests/conftest.py's fixture SRS:
 # server and clients derive identical keys from a spec alone
 TEST_TAU = 0xDEADBEEF
 
-_SPEC_KINDS = ("toy", "merkle")
+_SPEC_KINDS = ("toy", "merkle") + circuits.KINDS
 
 # SLO serving classes (ISSUE 16): flat ttl_s shedding grows into three
 # classes with per-class queue priority (flagship pops first), per-class
@@ -134,6 +142,8 @@ class JobSpec:
             if not isinstance(gates, int) or not 1 <= gates <= 1 << 16:
                 raise ValueError("toy spec needs 1 <= gates <= 65536")
             params = {"gates": gates}
+        elif kind in circuits.REGISTRY:
+            params = circuits.validate_params(kind, obj)
         else:
             height = obj.get("height")
             num_proofs = obj.get("num_proofs", 1)
@@ -195,6 +205,8 @@ def build_circuit(spec):
         ok, bad = ckt.check_satisfiability()
         assert ok, f"toy circuit unsatisfied at gate {bad}"
         return ckt.finalize()
+    if spec.kind in circuits.REGISTRY:
+        return circuits.build(spec.kind, spec.params, spec.seed)
     from ..workload import generate_circuit
     ckt, _tree = generate_circuit(
         rng=random.Random(spec.seed), height=spec.params["height"],

@@ -61,9 +61,17 @@ ROUND = "ROUND"
 DONE = "DONE"
 SHED = "SHED"
 FAILED = "FAILED"
+AGG = "AGG"      # aggregate artifact built (ISSUE 17): id is the
+                 # aggregate's content-addressed agg_id (NOT a job id);
+                 # the record carries the member job ids and the
+                 # artifact's store key+digest (or the JSON blob inline
+                 # hex when the service has no store) — recovery re-serves
+                 # the aggregate exactly like a DONE job's proof
 
 # replayed-state phases that mean "no further records will follow"
-TERMINAL_PHASES = ("done", "shed", "failed")
+# ("aggregate" rides along so compaction's retain_terminal bounds the
+# journal's memory of old aggregates the same way it bounds old jobs)
+TERMINAL_PHASES = ("done", "shed", "failed", "aggregate")
 
 # SHED-record reason prefix for admission-control rejections: the client
 # was told 'no' synchronously, so recovery keeps the verdict queryable
@@ -173,6 +181,18 @@ class JobJournal:
         rtype, jid = rec.get("t"), rec.get("id")
         if jid is None:
             return
+        if rtype == AGG:
+            # aggregates are their own single-record state entries: no
+            # SUBMIT precedes them, and no later record ever follows
+            self.state[jid] = {
+                "spec": None, "key": None, "deadline": None,
+                "submitted": rec.get("ts"), "trace": None,
+                "trace_parent": None, "phase": "aggregate", "round": 0,
+                "worker": None, "reason": None,
+                "done": {k: rec.get(k) for k in
+                         ("members", "store_key", "digest", "agg_hex")},
+            }
+            return
         st = self.state.get(jid)
         if st is None:
             if rtype != SUBMIT:
@@ -270,6 +290,12 @@ class JobJournal:
     @staticmethod
     def _state_records(jid, st):
         """Minimal record sequence that replays back to `st`."""
+        if st["phase"] == "aggregate":
+            rec = {"t": AGG, "id": jid, "ts": st["submitted"]}
+            rec.update({k: v for k, v in (st["done"] or {}).items()
+                        if v is not None})
+            yield rec
+            return
         sub = {"t": SUBMIT, "id": jid, "spec": st["spec"],
                "key": st["key"], "deadline": st["deadline"],
                "ts": st["submitted"]}

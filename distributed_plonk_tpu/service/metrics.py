@@ -304,6 +304,31 @@ closed-loop controller, ISSUE 16):
                                              TIMEOUT_S); a retire is
                                              never a flap and never
                                              respawns
+
+Circuit zoo + proof aggregation vocabulary (circuits/, aggregate.py,
+service/server.py AGGREGATE path — ISSUE 17):
+    circuit_kind_*                           jobs served to DONE per
+                                             circuit kind (circuit_kind_
+                                             toy, circuit_kind_range,
+                                             ...): the zoo mix as the
+                                             server actually proved it
+    aggregates_built                         batch-KZG aggregates built
+                                             (self-verified + journaled)
+    aggregate_members                        constituent proofs folded
+                                             into built aggregates
+                                             (members per build summed)
+    aggregate_verify_s (histogram)           server-side fold-then-one-
+                                             pairing-check latency per
+                                             built aggregate
+    aggregate_verify_failures                aggregate builds REJECTED by
+                                             the server's own verify gate
+                                             (nothing journaled/served)
+    aggregates_recovered                     aggregate artifacts restored
+                                             from the journal after a
+                                             restart
+    aggregate_artifacts_lost                 journaled aggregates whose
+                                             artifact bytes were gone at
+                                             recovery (store eviction)
 """
 
 import math

@@ -672,6 +672,10 @@ class WorkerPool:
         self._journal_done(job, proof_bytes, pub)
         self._store_trace(job, tracer)
         job.finish_ok(proof_bytes, pub, totals)
+        # per-kind served counter: the circuit-zoo mix as the server saw
+        # it (aggregation eligibility and console's by-kind pane both
+        # read job state; this is the cheap cumulative view)
+        self.metrics.inc("circuit_kind_%s" % job.spec.kind)
         # per-SLO-class roundtrip (submit -> served): the standard-class
         # p95_s of this histogram is the autoscaler's latency sensor
         self.metrics.observe(

@@ -122,6 +122,16 @@ class ProofService:
         # arms it per DPT_AUTOSCALE; None is the off-mode bit-parity state
         self.autoscaler = None
         self._profiles = {}  # storeless fallback: id -> (meta, blob)
+        # built aggregate artifacts (ISSUE 17): storeless fallback table
+        # agg_id -> JSON blob bytes, restored from the journal's AGG
+        # records at recovery; store-backed services serve from
+        # aggregate:<agg_id> instead. Bounded like the journal's memory
+        # of terminal jobs — refolding N DONE jobs is always possible.
+        self._aggregates = {}
+        self._aggregates_cap = max(64, finished_retention // 4)
+        # shape_key -> vk cache for aggregate self-verification (usually
+        # satisfied straight from the bucket cache, see aggregate_jobs)
+        self._agg_vk_cache = {}
         # structured logs (obs/log.py) publish their counters into this
         # registry (per-process buffer; last-constructed service wins,
         # which is the daemon case that matters)
@@ -229,6 +239,94 @@ class ProofService:
             if hit is not None:
                 return hit
         return self._profiles.get(profile_id)
+
+    # -- batch-KZG proof aggregation (aggregate.py, ISSUE 17) ------------------
+
+    def aggregate_jobs(self, job_ids):
+        """Fold N DONE jobs' proofs into one batch-KZG aggregate artifact
+        (the AGGREGATE wire tag's local implementation).
+
+        All-or-nothing by design: any unknown or non-DONE member raises
+        (LookupError / ValueError with the offending job id) — a partial
+        aggregate would silently weaken the client's "everything in this
+        batch verified" claim. The built artifact is self-verified (ONE
+        2-pair pairing check, vks served from the bucket cache the
+        members were just proved with), journaled as an AGG record, and
+        persisted as aggregate:<agg_id> (store) or in the in-memory
+        fallback table. Returns the AGGREGATE reply dict.
+        """
+        from .. import aggregate as AGG
+        if not isinstance(job_ids, list) or not job_ids \
+                or not all(isinstance(j, str) for j in job_ids):
+            raise ValueError("job_ids must be a non-empty list of ids")
+        members, kinds = [], []
+        for jid in job_ids:
+            job = self.get_job(jid)
+            if job is None:
+                raise LookupError(f"unknown job {jid!r}")
+            if job.state != J.DONE or job.proof_bytes is None:
+                raise ValueError(
+                    f"job {jid} not aggregatable (state={job.state})")
+            members.append({"job_id": job.id, "spec": job.spec.to_wire(),
+                            "pub": job.public_input,
+                            "proof": job.proof_bytes})
+            kinds.append(job.spec.kind)
+        t0 = time.monotonic()
+        agg = AGG.build(members)
+        blob = AGG.to_bytes(agg)
+        agg_id = agg["agg_id"]
+        # self-verify before anything durable: the pool already verified
+        # every member, so this pins the FOLD itself (and the vk cache is
+        # warm — the bucket cache just proved these shapes)
+        for jid in job_ids:
+            job = self.get_job(jid)
+            key = job.shape_key
+            if key not in self._agg_vk_cache:
+                self._agg_vk_cache[key] = self.buckets.get(job.spec).vk
+        t_v = time.monotonic()
+        if not AGG.verify(agg, self._agg_vk_cache):
+            self.metrics.inc("aggregate_verify_failures")
+            raise ValueError("aggregate self-verification failed")
+        self.metrics.observe("aggregate_verify_s", time.monotonic() - t_v)
+        rec = {"members": list(job_ids), "ts": time.time()}
+        digest = None
+        if self.store is not None:
+            from ..store import keycache as KC
+            digest = KC.store_aggregate(self.store, agg_id, blob,
+                                        job_ids, kinds=kinds)
+            rec["store_key"] = KC.aggregate_store_key(agg_id)
+            rec["digest"] = digest
+        else:
+            rec["agg_hex"] = blob.hex()
+        self._stash_aggregate(agg_id, blob)
+        # journal writers serialize on _submit_lock (same discipline as
+        # the SUBMIT write-ahead append)
+        if self.journal is not None:
+            with self._submit_lock:
+                self.journal.append(JN.AGG, agg_id, **rec)
+        build_s = time.monotonic() - t0
+        self.metrics.inc("aggregates_built")
+        self.metrics.inc("aggregate_members", len(members))
+        olog.emit("aggregate", "built", agg_id=agg_id,
+                  members=len(members), kinds=sorted(set(kinds)),
+                  build_s=round(build_s, 6))
+        return {"agg_id": agg_id, "members": list(job_ids),
+                "kinds": sorted(set(kinds)), "digest": digest,
+                "build_s": round(build_s, 6)}
+
+    def _stash_aggregate(self, agg_id, blob):
+        self._aggregates[agg_id] = blob
+        while len(self._aggregates) > self._aggregates_cap:
+            self._aggregates.pop(next(iter(self._aggregates)))
+
+    def load_aggregate_blob(self, agg_id):
+        """Canonical JSON blob of one built aggregate, or None."""
+        if self.store is not None:
+            from ..store import keycache as KC
+            hit = KC.load_aggregate(self.store, agg_id)
+            if hit is not None:
+                return hit[0]
+        return self._aggregates.get(agg_id)
 
     # -- local (in-process) API ----------------------------------------------
 
@@ -391,8 +489,14 @@ class ProofService:
         FAILED verdicts stay queryable."""
         if self.journal is None:
             return
-        recovered = finished = 0
+        recovered = finished = aggregates = 0
         for jid, st in list(self.journal.state.items()):
+            if st.get("phase") == "aggregate":
+                # AGG records carry no job spec: restore the artifact's
+                # serving path (store or fallback table) and move on
+                if self._restore_aggregate(jid, st):
+                    aggregates += 1
+                continue
             try:
                 spec = JobSpec.from_wire(st.get("spec"))
             except (ValueError, TypeError):
@@ -446,10 +550,35 @@ class ProofService:
             self.metrics.inc("jobs_recovered", recovered)
         if finished:
             self.metrics.inc("jobs_recovered_finished", finished)
+        if aggregates:
+            self.metrics.inc("aggregates_recovered", aggregates)
         self.metrics.gauge("queue_depth", self.queue.depth())
         # replay + recovery is the natural compaction point: the rewritten
         # log starts this process's epoch at its minimal size
         self.journal.compact()
+
+    def _restore_aggregate(self, agg_id, st):
+        """Re-arm serving one journaled aggregate after a restart: the
+        inline blob goes back into the fallback table; a store-backed
+        record just needs the artifact to still be present. False means
+        the artifact is gone (evicted/corrupt) — clients refold from the
+        member proofs, nothing crashes."""
+        rec = st.get("done") or {}
+        if rec.get("agg_hex"):
+            try:
+                self._stash_aggregate(agg_id, bytes.fromhex(rec["agg_hex"]))
+            except ValueError:
+                self.metrics.inc("aggregate_artifacts_lost")
+                return False
+            return True
+        if self.store is not None and rec.get("store_key"):
+            from ..store import keycache as KC
+            hit = KC.load_aggregate(self.store, agg_id)
+            if hit is not None:
+                self._stash_aggregate(agg_id, hit[0])
+                return True
+        self.metrics.inc("aggregate_artifacts_lost")
+        return False
 
     def _restore_done(self, job, st):
         """Restore a finished job from its DONE record: proof bytes come
@@ -667,6 +796,25 @@ class ProofService:
                     {"reason": f"bad_spec: {e}"}))
                 return None
             conn.send(protocol.OK, protocol.encode_json(out))
+        elif tag == protocol.AGGREGATE:
+            req = protocol.decode_json(payload)
+            try:
+                out = self.aggregate_jobs(req.get("job_ids"))
+            except (ValueError, LookupError) as e:
+                conn.send(protocol.ERR,
+                          protocol.encode_json({"reason": str(e)}))
+                return None
+            conn.send(protocol.OK, protocol.encode_json(out))
+        elif tag == protocol.AGG_FETCH:
+            agg_id = protocol.decode_json(payload).get("agg_id")
+            blob = self.load_aggregate_blob(agg_id) \
+                if isinstance(agg_id, str) else None
+            if blob is None:
+                conn.send(protocol.ERR, protocol.encode_json(
+                    {"reason": f"no aggregate {agg_id!r}"}))
+                return None
+            conn.send(protocol.OK, protocol.encode_result(
+                {"agg_id": agg_id, "bytes": len(blob)}, blob))
         elif tag == protocol.STORE_FETCH:
             # serve one artifact blob to a peer/replacement host: bucket
             # keys, prover checkpoints, anything under the store —
@@ -879,12 +1027,22 @@ def _obs_route(svc, path):
         return 200, "text/plain; version=0.0.4; charset=utf-8", \
             text.encode()
     if path == "/healthz":
+        # per-circuit-kind job counts (the console's workload-mix pane):
+        # what the zoo's heterogeneous traffic actually looks like inside
+        # the service, by kind -> {state: count}
+        by_kind = {}
+        with svc._jobs_lock:
+            for j in svc.jobs.values():
+                per = by_kind.setdefault(j.spec.kind, {})
+                per[j.state] = per.get(j.state, 0) + 1
         body = {
             "ok": True,
             "uptime_s": round(time.monotonic() - svc.metrics.started_at, 3),
             "queue_depth": svc.queue.depth(),
             "busy_workers": len(svc.pool.busy()),
             "draining": svc.queue.closed(),
+            "jobs_by_kind": by_kind,
+            "aggregates": len(svc._aggregates),
             # fleet summary (None without an attached fleet): the same
             # readiness truth the console and /fleet read — a LB can
             # route on width/suspects without scraping the full snapshot

@@ -123,6 +123,37 @@ def load_trace(store, job_id):
         return None
 
 
+# -- batch-aggregate artifacts (aggregate.py, ISSUE 17) ------------------------
+# One built aggregate (the canonical JSON blob aggregate.to_bytes emits)
+# joins the content-addressed surface next to the proofs it folds:
+# aggregate:<agg_id>, where <agg_id> is already the content address of
+# the member list. The journal's AGG record carries the digest returned
+# here, so a restarted service re-serves the artifact without refolding.
+
+def aggregate_store_key(agg_id):
+    return f"aggregate:{agg_id}"
+
+
+def store_aggregate(store, agg_id, blob, members, kinds=None):
+    """Persist one aggregate artifact; returns its content digest
+    (journaled in the AGG record)."""
+    meta = {"kind": "aggregate", "agg_id": agg_id,
+            "members": list(members)}
+    if kinds:
+        meta["circuit_kinds"] = sorted(set(kinds))
+    return store.put(aggregate_store_key(agg_id), blob, meta=meta)
+
+
+def load_aggregate(store, agg_id):
+    """-> (blob, meta) or None (evicted / integrity failure — clients
+    can always refold from the member proofs, never crash)."""
+    hit = store.get_entry(aggregate_store_key(agg_id))
+    if hit is None:
+        return None
+    blob, _digest, meta = hit
+    return blob, meta
+
+
 # -- on-demand profile artifacts (obs/profiling.py) ---------------------------
 # One PROFILE-tag capture (jax.profiler xplane tar.gz, or the pystacks
 # JSON fallback) joins the content-addressed surface: profile:<id> where

@@ -83,25 +83,40 @@ def _validate_proof_shape(proof):
     return all(isinstance(s, int) and 0 <= s < R_MOD for s in scalars)
 
 
-def verify(vk, pub_input, proof, domain=None, rng=None):
+def opening_terms(vk, pub_input, proof, u, domain=None):
+    """The verifier's final pairing equation, held open as MSM terms.
+
+    Returns (lhs_points, lhs_scalars, rhs_points, rhs_scalars) such that
+    the proof verifies iff
+
+        e(MSM(lhs), g2) * e(-MSM(rhs), tau_g2) == 1
+
+    with `u` the opening-fold challenge (verify() draws it from its rng;
+    verify_aggregate derives per-member u_j from the aggregation
+    transcript). Returns None when the proof fails any of the structural
+    validations (malformed shape, non-subgroup point, bad public input,
+    zeta landing in the domain) — callers must treat None as REJECT.
+    Keeping the terms un-evaluated is what makes batch aggregation a
+    one-liner: scale every member's scalars by r_j, concatenate, and the
+    N-proof check is still two MSMs and ONE 2-pair pairing_check.
+    """
     n = vk.domain_size
     domain = domain or P.Domain(n)
-    rng = rng or random.Random()
 
     if not _validate_proof_shape(proof):
-        return False
+        return None
     # Reject length mismatches: extra "public inputs" would land on non-IO
     # rows via L_i(zeta) and let a prover bind arbitrary claimed values.
     if len(pub_input) != vk.num_inputs:
-        return False
+        return None
     if not all(isinstance(x, int) and 0 <= x < R_MOD for x in pub_input):
-        return False
+        return None
 
     beta, gamma, alpha, zeta, vch = _replay_challenges(vk, pub_input, proof)
 
     vanish_eval = (pow(zeta, n, R_MOD) - 1) % R_MOD
     if vanish_eval == 0:
-        return False  # zeta landed in the domain; reject (prob ~ n/r)
+        return None  # zeta landed in the domain; reject (prob ~ n/r)
     zeta_minus_1_inv = fr_inv((zeta - 1) % R_MOD)
     n_inv = fr_inv(n % R_MOD)
     lagrange_1_eval = vanish_eval * n_inv % R_MOD * zeta_minus_1_inv % R_MOD
@@ -180,11 +195,10 @@ def verify(vk, pub_input, proof, domain=None, rng=None):
         batch_eval = (batch_eval + vpow * ev) % R_MOD
         vpow = vpow * vch % R_MOD
 
-    # fold the shifted opening in with a random u:
+    # fold the shifted opening in with the challenge u:
     #   e(C_batch - [batch_eval] + zeta W1
     #     + u (z_comm - [perm_next_eval] + omega zeta W2), g2)
     #   == e(W1 + u W2, tau g2)
-    u = rng.randrange(1, R_MOD)
     omega_zeta = domain.group_gen * zeta % R_MOD
 
     scalars.append((-batch_eval - u * proof.perm_next_eval) % R_MOD)
@@ -196,10 +210,63 @@ def verify(vk, pub_input, proof, domain=None, rng=None):
     scalars.append(u * omega_zeta % R_MOD)
     points.append(proof.shifted_opening_proof)
 
-    lhs = C.g1_msm(points, scalars)
-    rhs_w = C.g1_msm([proof.opening_proof, proof.shifted_opening_proof], [1, u])
+    rhs_points = [proof.opening_proof, proof.shifted_opening_proof]
+    rhs_scalars = [1, u]
+    return points, scalars, rhs_points, rhs_scalars
 
+
+def verify(vk, pub_input, proof, domain=None, rng=None):
+    rng = rng or random.Random()
+    u = rng.randrange(1, R_MOD)
+    terms = opening_terms(vk, pub_input, proof, u, domain=domain)
+    if terms is None:
+        return False
+    points, scalars, rhs_points, rhs_scalars = terms
+    lhs = C.g1_msm(points, scalars)
+    rhs_w = C.g1_msm(rhs_points, rhs_scalars)
     return C.pairing_check([
         (lhs, vk.g2),
         (C.g1_neg(rhs_w), vk.tau_g2),
+    ])
+
+
+def verify_aggregate(members, domains=None):
+    """Batched verification: N proofs, ONE 2-pair pairing check.
+
+    members: [(vk, pub_input, proof, u, r)] where (u, r) are the
+    per-member opening-fold and linear-combination challenges (derived by
+    aggregate.derive_challenges from the aggregation transcript — never
+    chosen by the prover). Folds every member's pairing equation by the
+    random r_j:
+
+        e(sum_j r_j lhs_j, g2) * e(-sum_j r_j (W1_j + u_j W2_j), tau_g2)
+
+    which is 1 iff (w.h.p. over the r_j) EVERY constituent equation
+    holds — a single member failing makes the fold nonzero except with
+    probability ~1/r. All members must share the same SRS tail (g2,
+    tau_g2): distinct-tau members would pair against different tau_g2
+    and cannot be folded, so that is a structural REJECT, not an assert.
+    """
+    if not members:
+        return False
+    g2, tau_g2 = members[0][0].g2, members[0][0].tau_g2
+    lhs_points, lhs_scalars = [], []
+    rhs_points, rhs_scalars = [], []
+    for vk, pub_input, proof, u, r in members:
+        if vk.g2 != g2 or vk.tau_g2 != tau_g2:
+            return False
+        domain = (domains or {}).get(vk.domain_size)
+        terms = opening_terms(vk, pub_input, proof, u, domain=domain)
+        if terms is None:
+            return False
+        points, scalars, rpoints, rscalars = terms
+        lhs_points += points
+        lhs_scalars += [r * s % R_MOD for s in scalars]
+        rhs_points += rpoints
+        rhs_scalars += [r * s % R_MOD for s in rscalars]
+    lhs = C.g1_msm(lhs_points, lhs_scalars)
+    rhs_w = C.g1_msm(rhs_points, rhs_scalars)
+    return C.pairing_check([
+        (lhs, g2),
+        (C.g1_neg(rhs_w), tau_g2),
     ])

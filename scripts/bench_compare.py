@@ -54,7 +54,8 @@ def check_committed(repo, scale, verbose=False):
         return [], {"records": 0, "note": "no trajectory to check"}
     cur = records[-1]
     prev = BR.latest_of_basis(records, cur.get("basis"),
-                              before=len(records) - 1)
+                              before=len(records) - 1,
+                              source=cur.get("source"))
     detail = {"records": len(records), "basis": cur.get("basis"),
               "cur_source": cur.get("source"), "cur_run": cur.get("run")}
     if prev is None:
@@ -69,7 +70,8 @@ def check_committed(repo, scale, verbose=False):
         # informational sweep over the whole history (never gates)
         for i in range(1, len(records)):
             p = BR.latest_of_basis(records, records[i].get("basis"),
-                                   before=i)
+                                   before=i,
+                                   source=records[i].get("source"))
             if p is None:
                 continue
             for r in BR.compare(p, records[i], scale=scale):
@@ -85,7 +87,8 @@ def check_line(repo, line, scale):
     data = json.loads(line)
     cur = BR.normalize("bench", data)
     records = BR.load_trajectory(repo)
-    prev = BR.latest_of_basis(records, cur["basis"])
+    prev = BR.latest_of_basis(records, cur["basis"],
+                              source=cur["source"])
     if prev is None:
         return [], {"note": f"no committed {cur['basis']} record",
                     "basis": cur["basis"]}

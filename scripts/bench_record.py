@@ -149,9 +149,11 @@ WATCH = [
     ("self_verify_bytes_identical", ("true", 0)),
     ("trace_ctx_adopted", ("true", 0)),
     ("autoscale_canary_ok", ("true", 0)),
+    ("aggregate_ok", ("true", 0)),
     # serving throughput + kernel A/Bs (ratios are basis-stable)
     ("proofs_per_s", ("higher", 0.5)),
     ("batch_prove_speedup_vs_sequential", ("higher", 0.4)),
+    ("aggregate_verify_speedup_vs_sequential", ("higher", 0.5)),
     ("autotune_speedup_vs_defaults", ("higher", 0.5)),
     ("ntt_radix4_speedup_vs_radix2", ("higher", 0.5)),
     ("*_vs_host_oracle", ("higher", 0.5)),
@@ -214,11 +216,15 @@ def compare(prev, cur, scale=1.0):
     return out
 
 
-def latest_of_basis(records, basis, before=None):
+def latest_of_basis(records, basis, before=None, source=None):
     """Most recent record of `basis` (optionally excluding the tail
-    element `before` compares against)."""
+    element `before` compares against). With `source`, only records of
+    that source pair — a loadgen soak line and a bench line share no
+    watched keys, so letting one shadow the other's predecessor would
+    make the gate vacuous."""
     pool = records if before is None else records[:before]
     for rec in reversed(pool):
-        if rec.get("basis") == basis:
+        if rec.get("basis") == basis and \
+                (source is None or rec.get("source") == source):
             return rec
     return None

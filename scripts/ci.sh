@@ -63,7 +63,16 @@
 #                          zero-actuator-calls pin, DPT_AUTOSCALE=0
 #                          parity, graceful retire (drain-then-LEAVE),
 #                          and the live supervised-fleet scale-up/
-#                          retire canary (every proof byte-verified)
+#                          retire canary (every proof byte-verified),
+#                          PLUS the circuit-zoo + aggregation suite
+#                          (ISSUE 17): per-kind satisfiability +
+#                          structure-from-params + prove/verify byte
+#                          determinism, batch-KZG aggregate accepts iff
+#                          every member verifies (single 2-pair pairing
+#                          check pinned by counter), corrupted-member +
+#                          tampered-artifact rejection, and the service
+#                          AGGREGATE round trip surviving restart
+#                          (journal AGG recovery)
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
   exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
@@ -82,6 +91,7 @@ if [ "$1" = "chaos" ]; then
     tests/test_service_journal.py \
     tests/test_trace.py tests/test_obs.py tests/test_fleet_obs.py \
     tests/test_placement.py tests/test_autoscale.py \
+    tests/test_circuits.py tests/test_aggregate.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 if [ "$1" = "autotune" ]; then

@@ -88,6 +88,15 @@ def render(base, log_tail=0):
                  f"queue={h.get('queue_depth')} "
                  f"busy={h.get('busy_workers')} "
                  f"draining={h.get('draining')}")
+    by_kind = h.get("jobs_by_kind") or {}
+    if by_kind:
+        # circuit-zoo pane: per-kind job table + built-aggregate count
+        kinds = " ".join(
+            "%s(%s)" % (k, ",".join(f"{s}={n}"
+                                    for s, n in sorted(v.items())))
+            for k, v in sorted(by_kind.items()))
+        lines.append(f"circuits {kinds} "
+                     f"aggregates={h.get('aggregates', 0)}")
     if flt:
         lines.append(f"fleet    epoch={flt['epoch']} width={flt['width']} "
                      f"usable={flt['usable']} suspects={flt['suspects']} "

@@ -12,6 +12,12 @@
         # the closed-loop controller must ramp workers up into the peak
         # and retire them (drain-then-LEAVE) after it — every proof
         # byte-verified, zero flagship sheds
+    python scripts/loadgen.py \
+        --circuit-mix range=0.3,merkle=0.3,rollup=0.2,toy=0.2
+        # circuit-zoo soak: every job's kind drawn from the weights,
+        # every proof byte-verified, then the whole batch folded into
+        # ONE batch-KZG aggregate verified client-side with a single
+        # 2-pair pairing check (--aggregate-only accepts on that alone)
     python scripts/loadgen.py --kill-service ROUND2        # restart soak:
         # spawns scripts/serve.py as a real subprocess (journal + store),
         # submits the job mix with idempotency keys, SIGKILLs the SERVICE
@@ -112,6 +118,191 @@ def _parse_slo_mix(arg):
     if not mix or sum(mix.values()) <= 0:
         raise SystemExit("--slo-mix: needs at least one positive weight")
     return mix
+
+
+# circuit-zoo shapes per kind (--circuit-mix): the smallest spec of each
+# family that still runs its real gadgets — range decomposition n=32,
+# one 3-ary Merkle membership / one Rescue preimage n=256, one rollup
+# account update under a height-1 tree n=1024 (the expensive one)
+_ZOO_SPECS = {
+    "toy": {"kind": "toy", "gates": 16},
+    "range": {"kind": "range", "bits": 8, "count": 2},
+    "merkle": {"kind": "merkle", "height": 1, "num_proofs": 1},
+    "preimage": {"kind": "preimage", "count": 1},
+    "rollup": {"kind": "rollup", "height": 1, "updates": 1,
+               "num_accounts": 2},
+}
+
+
+def _parse_circuit_mix(arg):
+    """'range=0.3,merkle=0.3,rollup=0.2,toy=0.2' -> {kind: weight}, same
+    contract as _parse_slo_mix (normalized at draw time, unknown kinds
+    fail fast naming the flag)."""
+    mix = {}
+    for entry in arg.split(","):
+        name, sep, w = entry.strip().partition("=")
+        if not sep or name not in _ZOO_SPECS:
+            raise SystemExit(f"--circuit-mix: {entry.strip()!r} is not "
+                             f"<kind>=<weight> with kind in "
+                             f"{tuple(sorted(_ZOO_SPECS))}")
+        try:
+            mix[name] = float(w)
+        except ValueError:
+            raise SystemExit(f"--circuit-mix: {w!r} is not a number")
+    if not mix or sum(mix.values()) <= 0:
+        raise SystemExit("--circuit-mix: needs at least one positive "
+                         "weight")
+    return mix
+
+
+def run_circuit_mix_soak(args):
+    """--circuit-mix: the circuit-zoo + proof-aggregation soak (ISSUE 17).
+    Each job's kind is drawn from the seeded weights, proved through the
+    full service path, and byte-verified against a local uninterrupted
+    prove. Then ONE AGGREGATE call folds every DONE job into a single
+    batch-KZG artifact, which is fetched back and verified CLIENT-SIDE —
+    one 2-pair pairing check for the whole batch, pinned in the summary
+    by the curve-level pairing counters. --aggregate-only drops the
+    per-proof verification: the batch is accepted on the aggregate alone
+    (the 'N proofs in, one pairing check out' client mode). The summary
+    reports per-kind submitted/done/verified/p50/p95; --record appends
+    it to bench_artifacts/trajectory.jsonl."""
+    from distributed_plonk_tpu import aggregate as AGG
+    from distributed_plonk_tpu import curve
+    from distributed_plonk_tpu.service import ProofService, ServiceClient
+
+    t0 = time.time()
+    mix = _parse_circuit_mix(args.circuit_mix)
+    kinds_sorted = sorted(mix)
+    wsum = sum(mix[k] for k in kinds_sorted)
+    rng = random.Random(args.chaos_seed)
+    draws = []
+    for _ in range(args.jobs):
+        r = rng.random() * wsum
+        acc, kind = 0.0, kinds_sorted[-1]
+        for k in kinds_sorted:
+            acc += mix[k]
+            if r < acc:
+                kind = k
+                break
+        draws.append(kind)
+
+    svc = ProofService(port=0, prover_workers=args.workers, chaos=True,
+                       allow_remote_shutdown=True,
+                       store_dir=args.store_dir).start()
+    results = []
+    results_lock = threading.Lock()
+
+    def submitter(i, kind):
+        spec = dict(_ZOO_SPECS[kind], seed=7000 + i)
+        out = {"index": i, "kind": kind, "spec": spec}
+        t_sub = time.monotonic()
+        try:
+            with ServiceClient("127.0.0.1", svc.port) as c:
+                out["job_id"] = c.submit(spec)["job_id"]
+                st = c.wait(out["job_id"], timeout_s=args.timeout)
+                out["state"] = st["state"]
+                out["roundtrip_s"] = round(time.monotonic() - t_sub, 4)
+                if st["state"] == "done":
+                    _hdr, blob = c.result(out["job_id"])
+                    if not args.aggregate_only:
+                        out["verified"] = blob == _proof_reference(spec)
+                else:
+                    out["error"] = st.get("error")
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            out["error"] = repr(e)
+        with results_lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=submitter, args=(i, k), daemon=True)
+               for i, k in enumerate(draws)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=args.timeout)
+
+    # the aggregation leg: every DONE job folds into ONE artifact; the
+    # client re-derives the vks from the same deterministic test SRS and
+    # accepts the whole batch on a single pairing check
+    agg_report = {}
+    metrics = {"counters": {}}
+    try:
+        done_ids = [r["job_id"] for r in
+                    sorted(results, key=lambda r: r["index"])
+                    if r.get("state") == "done"]
+        with ServiceClient("127.0.0.1", svc.port) as c:
+            if done_ids:
+                rep = c.aggregate(done_ids)
+                agg = c.fetch_aggregate(rep["agg_id"])
+                curve.reset_pairing_counters()
+                t_v = time.monotonic()
+                agg_ok = AGG.verify(agg)
+                agg_report = {
+                    "agg_id": rep["agg_id"],
+                    "members": len(rep["members"]),
+                    "kinds": rep["kinds"],
+                    "verified": bool(agg_ok),
+                    "verify_s": round(time.monotonic() - t_v, 4),
+                    "pairing_checks": dict(curve.PAIRING_COUNTERS),
+                }
+            metrics = c.metrics()
+            c.shutdown_server()
+    finally:
+        svc.shutdown()
+
+    sc = metrics["counters"]
+    per_kind = {}
+    for k in kinds_sorted:
+        rs = [r for r in results if r["kind"] == k]
+        rts = sorted(r["roundtrip_s"] for r in rs
+                     if r.get("state") == "done"
+                     and r.get("roundtrip_s") is not None)
+
+        def pct(p, rts=rts):
+            if not rts:
+                return None
+            return round(rts[min(len(rts) - 1, int(p * len(rts)))], 4)
+
+        per_kind[k] = {
+            "submitted": len(rs),
+            "done": sum(1 for r in rs if r.get("state") == "done"),
+            "verified": sum(1 for r in rs if r.get("verified")),
+            "served_counter": sc.get("circuit_kind_%s" % k, 0),
+            "p50_s": pct(0.50),
+            "p95_s": pct(0.95),
+        }
+    done = sum(1 for r in results if r.get("state") == "done")
+    shed = sum(1 for r in results if r.get("state") == "shed")
+    verified = sum(1 for r in results if r.get("verified"))
+    # the contract: every job served (zero sheds), the aggregate's one
+    # pairing check accepted the whole batch, and (unless aggregate-only)
+    # every proof byte-identical to a local prove
+    ok = (done == args.jobs and shed == 0
+          and agg_report.get("verified") is True
+          and (args.aggregate_only or verified == done))
+    summary = {
+        "mode": "circuit-mix", "ok": ok,
+        "wall_s": round(time.time() - t0, 3),
+        "jobs": args.jobs, "circuit_mix": mix,
+        "verify": ("aggregate-only" if args.aggregate_only
+                   else "per-proof-bytes"),
+        "verified": verified, "shed": shed,
+        "failed": [r for r in results if r.get("state") != "done"],
+        "kinds": per_kind,
+        "aggregate": agg_report,
+        "aggregates_built": sc.get("aggregates_built", 0),
+    }
+    if args.record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        import bench_record
+        repo = os.path.dirname(here)
+        rec = bench_record.normalize(
+            "loadgen", dict(summary, backend="python"))
+        summary["recorded"] = bench_record.append(rec, repo=repo)
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 1
 
 
 def _traffic_schedule(model, jobs, duration_s, seed, slo_mix):
@@ -724,6 +915,20 @@ def main():
                          "'flat' constant rate; the summary reports "
                          "per-class latency percentiles + sheds and the "
                          "controller's decision trail")
+    ap.add_argument("--circuit-mix", default=None, metavar="KIND=W,...",
+                    help="circuit-zoo + aggregation soak (ISSUE 17): "
+                         "draw each job's kind from these weights "
+                         "(kinds: toy, range, merkle, preimage, rollup), "
+                         "byte-verify every served proof against a local "
+                         "prove, then AGGREGATE the whole batch and "
+                         "verify the ONE batched opening client-side "
+                         "(a single 2-pair pairing check); e.g. "
+                         "range=0.3,merkle=0.3,rollup=0.2,toy=0.2")
+    ap.add_argument("--aggregate-only", action="store_true",
+                    help="--circuit-mix: skip per-proof verification — "
+                         "accept the batch on the aggregate's single "
+                         "pairing check alone ('N proofs in, one "
+                         "pairing check out')")
     ap.add_argument("--slo-mix", default="standard=1.0",
                     metavar="CLS=W,...",
                     help="SLO-class weights for --traffic arrivals, "
@@ -736,13 +941,15 @@ def main():
                     help="--traffic: override DPT_AUTOSCALE for the soak "
                          "(default: the environment decides)")
     ap.add_argument("--record", action="store_true",
-                    help="--traffic: append the summary (basis: "
-                         "host-oracle) to bench_artifacts/"
+                    help="--traffic/--circuit-mix: append the summary "
+                         "(basis: host-oracle) to bench_artifacts/"
                          "trajectory.jsonl via scripts/bench_record.py")
     ap.add_argument("--timeout", type=float, default=600)
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.circuit_mix is not None:
+        return run_circuit_mix_soak(args)
     if args.traffic is not None:
         return run_traffic_soak(args)
     if args.kill_service is not None:
