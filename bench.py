@@ -25,6 +25,8 @@ Env knobs:
   DPT_BENCH_PROVE_HOST=1 (re)measure the host-oracle prove baseline too
   DPT_BENCH_TIMEOUT      inner measurement budget, seconds (default 3000)
   DPT_BENCH_PROBE_TIMEOUT  per-probe budget, seconds (default 150)
+  DPT_BENCH_PIPELINE_TIMEOUT  pipeline A/B budget, seconds (default 1500;
+                           a cold XLA compile-cache fill is ~450 s)
 """
 
 import json
@@ -1009,6 +1011,79 @@ def service_roundtrip_main():
         shutil.rmtree(store_dir, ignore_errors=True)
 
 
+def pipeline_ab_main():
+    """Round-pipelined proving A/B (PR 18): the SAME N jobs proved
+    through prover.prove_pipelined at depth=1 (lockstep: launch, force,
+    finalize, one member at a time) vs depth=4 (members staggered so one
+    member's async commit/eval dispatches overlap the others' host
+    transcript + challenge work). Byte-identity vs the python-oracle
+    sequential proves is asserted for BOTH arms — the speedup must come
+    from overlap alone, never from a schedule change the bytes could
+    observe.
+
+    Basis: the jax backend on whatever platform this process sees
+    (XLA:CPU in CI — its async dispatch is what the pipeline hides host
+    work behind; the chip-basis depth sweep is ROADMAP item (g)), with
+    the persistent compile cache under bench_artifacts/jax_cache so
+    repeat runs skip XLA compiles. Falls back to the host oracle
+    (GIL-bound: expect ~1.0x) if jax is unusable. Prints one JSON
+    line."""
+    import random as _random
+    from distributed_plonk_tpu import prover
+    from distributed_plonk_tpu.prover import prove
+    from distributed_plonk_tpu.backend.python_backend import PythonBackend
+    from distributed_plonk_tpu.proof_io import serialize_proof
+    from distributed_plonk_tpu.service.jobs import (JobSpec, build_bucket_keys,
+                                                    build_circuit)
+
+    n_jobs, gates = 4, 16
+    specs = [JobSpec.from_wire({"kind": "toy", "gates": gates,
+                                "seed": 7100 + i}) for i in range(n_jobs)]
+    pk = build_bucket_keys(specs[0])[1]
+    oracle = [serialize_proof(prove(_random.Random(s.seed),
+                                    build_circuit(s), pk, PythonBackend()))
+              for s in specs]
+    basis = "jax backend (async dispatch), XLA compile cache warm"
+    try:
+        from distributed_plonk_tpu.backend import field_jax
+        from distributed_plonk_tpu.backend.jax_backend import JaxBackend
+        field_jax.configure_compile_cache(
+            os.path.join(REPO, "bench_artifacts", "jax_cache"),
+            min_compile_secs=0.5)
+        be = JaxBackend()
+        warm = serialize_proof(prove(_random.Random(specs[0].seed),
+                                     build_circuit(specs[0]), pk, be))
+        if warm != oracle[0]:
+            raise RuntimeError("jax sequential bytes != host oracle")
+    except Exception as e:  # no usable jax: host-oracle fallback
+        be = PythonBackend()
+        basis = f"host oracle (jax unusable: {e!r}; GIL-bound)"
+
+    def arm(depth):
+        ckts = [build_circuit(s) for s in specs]
+        t0 = time.perf_counter()
+        proofs, errors = prover.prove_pipelined(
+            [_random.Random(s.seed) for s in specs], ckts, pk, be,
+            depth=depth)
+        dt = time.perf_counter() - t0
+        ok = (errors == [None] * n_jobs
+              and [serialize_proof(p) for p in proofs] == oracle)
+        return dt, ok
+
+    t1, ok1 = arm(1)
+    t4, ok4 = arm(4)
+    print(json.dumps({
+        "pipelined_proofs_per_s": round(n_jobs / t4, 3) if t4 else None,
+        "pipeline_speedup_vs_lockstep":
+            round(t1 / t4, 3) if t4 else None,
+        "pipeline_byte_identical": bool(ok1 and ok4),
+        "pipeline_ab_jobs": n_jobs,
+        "pipeline_ab_depth1_s": round(t1, 3),
+        "pipeline_ab_depth4_s": round(t4, 3),
+        "pipeline_ab_basis": basis,
+    }))
+
+
 def fleet_chaos_main():
     """The fault-domain regression canary: run one fully distributed prove
     (3 python-backend worker processes over real TCP, sharded 4-step FFTs
@@ -1517,6 +1592,34 @@ def _measure_sdc_heal():
                 "sdc_error": repr(e)}
 
 
+def _measure_pipeline_ab():
+    """Run pipeline_ab_main in a scrubbed-CPU subprocess; returns its keys
+    or {pipeline_byte_identical: False, pipeline_ab_error} — every bench
+    line records whether round-pipelined proving (depth=4) beats lockstep
+    (depth=1) on the same jobs with byte-identical proofs. Own timeout
+    knob: a cold XLA compile of the jax prover is ~450 s before the two
+    timed arms even start (warm cache: ~6 min total). Never fails the
+    bench."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pipeline-ab"],
+            cwd=REPO, env=_scrubbed_cpu_env(), capture_output=True, text=True,
+            timeout=int(os.environ.get("DPT_BENCH_PIPELINE_TIMEOUT", "1500")))
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            if line.strip().startswith("{"):
+                return json.loads(line)
+        return {"pipeline_byte_identical": False,
+                "pipeline_speedup_vs_lockstep": None,
+                "pipelined_proofs_per_s": None,
+                "pipeline_ab_error":
+                    f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except Exception as e:
+        return {"pipeline_byte_identical": False,
+                "pipeline_speedup_vs_lockstep": None,
+                "pipelined_proofs_per_s": None,
+                "pipeline_ab_error": repr(e)}
+
+
 def _measure_service_roundtrip():
     """Run service_roundtrip_main in a scrubbed-CPU subprocess; returns its
     keys, or {service_error} — the bench line never fails on it."""
@@ -1552,6 +1655,9 @@ def main():
     if "--sdc-heal" in sys.argv:
         sdc_heal_main()
         return
+    if "--pipeline-ab" in sys.argv:
+        pipeline_ab_main()
+        return
     try:
         os.remove(_PARTIAL)
     except OSError:
@@ -1571,6 +1677,7 @@ def main():
         svc_box.update(_measure_fleet_chaos())
         svc_box.update(_measure_fleet_heal())
         svc_box.update(_measure_sdc_heal())
+        svc_box.update(_measure_pipeline_ab())
         svc_box.update(_measure_analysis_clean())
 
     svc_thread = threading.Thread(target=_side_measurements, daemon=True)
@@ -1580,6 +1687,7 @@ def main():
         svc_thread.join(
             timeout=int(os.environ.get("DPT_BENCH_SERVICE_TIMEOUT", "300"))
             + 3 * int(os.environ.get("DPT_BENCH_FLEET_TIMEOUT", "300"))
+            + int(os.environ.get("DPT_BENCH_PIPELINE_TIMEOUT", "1500"))
             + int(os.environ.get("DPT_BENCH_ANALYSIS_TIMEOUT", "600")) + 30)
         out = dict(svc_box)
         if not any(k.startswith("service") for k in out):
@@ -1596,6 +1704,11 @@ def main():
             out["sdc_detected_ok"] = False
             out["sdc_heal_s"] = None
             out["sdc_error"] = "did not finish"
+        if "pipeline_byte_identical" not in out:
+            out["pipeline_byte_identical"] = False
+            out["pipeline_speedup_vs_lockstep"] = None
+            out["pipelined_proofs_per_s"] = None
+            out["pipeline_ab_error"] = "did not finish"
         if "analysis_clean" not in out:
             out["analysis_clean"] = False
             out["analysis_detail"] = "did not finish"

@@ -55,6 +55,18 @@ _R3_FUSE = os.environ.get("DPT_R3_FUSE", "1") != "0"
 _R3_BITREV = os.environ.get("DPT_R3_BITREV", "1") != "0"
 
 
+class _DevicePending:
+    """Dispatched-but-unforced device result (commit_many_async /
+    eval_many_async): jax has already enqueued the launches; force() pays
+    the device→host transfer. The prover's pipeline driver forces only at
+    the owning member's host-finalize."""
+
+    __slots__ = ("force",)
+
+    def __init__(self, force):
+        self.force = force
+
+
 class JaxBackend:
     """Backend over single-device jitted kernels.
 
@@ -630,6 +642,31 @@ class JaxBackend:
         independent; grouping only changes launch boundaries)."""
         return self._ctx(ck).msm_mont_limbs_many(
             hs, chunk=max(1, self._MSM_JOB_BATCH))
+
+    def commit_many_async(self, ck, hs):
+        """Async commit dispatch (prover round pipeline): enqueue the MSM
+        launches for `hs` and return an unforced pending whose force()
+        performs the host-side decode. Values are bit-identical to
+        commit_many_h — only WHEN the host blocks moves, which is what
+        lets a pipelined member's host-finalize overlap another member's
+        dispatched device work."""
+        return self._ctx(ck).msm_mont_limbs_many_async(hs)
+
+    def eval_many_async(self, pairs):
+        """Async eval_many_h: the batched evaluation launch is enqueued
+        here; the transfer + canonical decode run at pending.force()."""
+        from .limbs import limbs_to_ints
+
+        L = max(h.shape[1] for h, _ in pairs)
+        polys = jnp.stack([jnp.pad(h, ((0, 0), (0, L - h.shape[1])))
+                           for h, _ in pairs])  # (B, 16, L)
+        zs = jnp.stack([jnp.asarray(PJ.lift_scalar(p)) for _, p in pairs])
+        out = PJ.poly_eval_many_jit(polys, zs)  # (16, B) canonical
+
+        def force():
+            self.lowers += 1  # B scalars cross in one transfer
+            return limbs_to_ints(np.asarray(out))
+        return _DevicePending(force)
 
     def degree_is(self, h, d):
         if h.shape[1] <= d:

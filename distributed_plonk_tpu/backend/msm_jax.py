@@ -984,7 +984,8 @@ class MsmContext:
     # shapes small across prover rounds (8, then the 5/2-size residuals)
     _BATCH_CHUNK = int(os.environ.get("DPT_MSM_BATCH", "8"))
 
-    def _run_batches(self, items, make_digits, chunk=None, stacked=False):
+    def _run_batches(self, items, make_digits, chunk=None, stacked=False,
+                     defer=False):
         """items -> affine points; digits are materialized per batch chunk
         so peak digit memory is `chunk` (default _BATCH_CHUNK) tensors,
         not len(items).
@@ -998,16 +999,24 @@ class MsmContext:
         Double-buffered: batch k's (24, B) device totals convert to host
         only AFTER batch k+1's work is enqueued, so the device never sits
         idle behind the host-side decode fence (the totals are tiny; only
-        ONE extra batch's queued work is ever outstanding)."""
-        out = []
-        pending = None  # (batch_width, device totals) awaiting decode
+        ONE extra batch's queued work is ever outstanding).
+
+        defer=True: ALL launches are still enqueued here, in order — but
+        every host-side projective decode moves into the returned
+        _MsmPending's force(). This is the async commit path: the
+        pipelined prover dispatches a member's round commits, then runs
+        another member's host work before forcing. The one exception is
+        the calibration fence below, which must block either way — a
+        fence-drained batch rides the pending as already-decoded points."""
+        # one entry per batch chunk, in item order; a drain rewrites the
+        # entry in place so deferred and eager decodes can interleave
+        parts = []  # ["dev", batch_width, device totals] | ["done", points]
+        pending = None  # last parts entry still awaiting decode
         batch_chunk = chunk or self._BATCH_CHUNK
 
-        def drain(p):
-            B, (tx, ty, tz) = p
-            tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
-            out.extend(_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
-                       for j in range(B))
+        def drain(part):
+            if part[0] == "dev":
+                part[:] = ["done", _decode_totals(part[1], part[2])]
 
         for i in range(0, len(items), batch_chunk):
             # until the one-shot adds/s calibration has latched, drain the
@@ -1025,11 +1034,16 @@ class MsmContext:
             else:
                 digits = jnp.stack([make_digits(it) for it in part_items])
             totals = self._exec_chunked(digits)
-            if pending is not None:
+            if pending is not None and not defer:
                 drain(pending)
-            pending = (digits.shape[0], totals)
-        if pending is not None:
-            drain(pending)
+            pending = ["dev", digits.shape[0], totals]
+            parts.append(pending)
+        if defer:
+            return _MsmPending(parts)
+        out = []
+        for part in parts:
+            drain(part)
+            out.extend(part[1])
         return out
 
     def msm_mont_limbs_many(self, hs, chunk=None):
@@ -1043,6 +1057,16 @@ class MsmContext:
             assert h.shape[1] <= self.n, (h.shape, self.n)
         return self._run_batches(hs, self._digits_batch_fn, chunk=chunk,
                                  stacked=True)
+
+    def msm_mont_limbs_many_async(self, hs, chunk=None):
+        """Like msm_mont_limbs_many, but returns an unforced _MsmPending:
+        the digit-extraction + bucket-accumulation launches are enqueued
+        before returning; the host-side projective decode (the part that
+        blocks on the device) runs at pending.force()."""
+        for h in hs:
+            assert h.shape[1] <= self.n, (h.shape, self.n)
+        return self._run_batches(hs, self._digits_batch_fn, chunk=chunk,
+                                 stacked=True, defer=True)
 
     def msm_many(self, scalar_lists):
         """B MSMs over host int scalar lists in batched launches."""
@@ -1074,6 +1098,36 @@ def _c_batch_knob(n=None):
     except (TypeError, ValueError):
         return MsmContext._C_BATCH
     return c if c in C_CHOICES else MsmContext._C_BATCH
+
+
+def _decode_totals(B, totals):
+    """One batch chunk's (24, B) device totals -> B affine host points.
+    The np.asarray calls are the device sync point."""
+    tx, ty, tz = totals
+    tx, ty, tz = np.asarray(tx), np.asarray(ty), np.asarray(tz)
+    return [_proj_limbs_to_affine(tx[:, j], ty[:, j], tz[:, j])
+            for j in range(B)]
+
+
+class _MsmPending:
+    """Deferred MSM results from _run_batches(defer=True): every launch is
+    already enqueued; force() walks the batch parts in item order and
+    performs the host-side decodes (parts the calibration fence already
+    drained pass through). Exactly one consumer forces — the prover
+    member's host-finalize."""
+
+    __slots__ = ("_parts",)
+
+    def __init__(self, parts):
+        self._parts = parts
+
+    def force(self):
+        out = []
+        for part in self._parts:
+            if part[0] == "dev":
+                part[:] = ["done", _decode_totals(part[1], part[2])]
+            out.extend(part[1])
+        return out
 
 
 def _proj_limbs_to_affine(tx, ty, tz):

@@ -178,6 +178,14 @@ def _flip_middle_byte(path):
         return False
 
 
+# DPT_CKPT_FSYNC=1: fsync the snapshot tmp file before the atomic rename.
+# Default off (the historical contract — atomic-rename-only survives a
+# process crash, which is what the kill/drain guards need); on makes the
+# latch durable against power loss. Under the round pipeline the fsync is
+# pure host-finalize work that overlaps other members' device launches.
+_CKPT_FSYNC = os.environ.get("DPT_CKPT_FSYNC", "0") != "0"
+
+
 class ProverCheckpoint:
     """Round-boundary checkpoint store backed by one .npz file.
 
@@ -197,12 +205,16 @@ class ProverCheckpoint:
     # -- write ---------------------------------------------------------------
 
     def save(self, round_no, fingerprint, rng, transcript, arrays, meta):
-        """Persist a completed round atomically."""
+        """Persist a completed round atomically (tmp write + rename;
+        optionally fsync'd — _CKPT_FSYNC)."""
         blob = encode_snapshot(round_no, fingerprint, rng, transcript,
                                arrays, meta)
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+            if _CKPT_FSYNC:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self.path)
 
     # -- read ----------------------------------------------------------------

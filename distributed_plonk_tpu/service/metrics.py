@@ -73,6 +73,27 @@ Placement + cross-job batched proving (service/placement.py, pool.py):
     store_write_errors                               best-effort artifact
                                                      writes that failed
 
+Round-pipelined proving (prover.PipelinedProver via pool._run_pipeline):
+    pipelined_proves                         pipelined attempts launched
+                                             (one per coalesced window)
+    pipelined_jobs                           jobs proved inside pipelined
+                                             attempts
+    pipeline_depth (gauge)                   members in flight at the last
+                                             observed stage boundary
+    pipeline_depth_achieved (histogram)      in-flight depth sampled at
+                                             every stage finalize (the
+                                             fill the pipeline actually
+                                             achieved vs DPT_PIPELINE_DEPTH)
+    pipeline_stage_wait_s (histogram)        driver wait for a member's
+                                             oldest ready stage (also per
+                                             round: pipeline_stage_wait_s/
+                                             round<N>)
+    pipeline_device_idle_s/round<N> (gauge)  host-finalize span not covered
+                                             by the device force — the
+                                             serial host work the pipeline
+                                             overlaps with other members'
+                                             launches
+
 Artifact store, scoped `store_*` (store/artifacts.py, store/remote.py):
     store_hits / store_misses / store_evictions      blob cache activity
     store_corrupt                                    integrity failures on

@@ -20,7 +20,15 @@ decision instead of straight onto the pool:
                the prove SHARDS over a leased submesh via
                parallel.MeshBackend — latency scales in shards while the
                rest of the pool keeps serving.
-      "pool"   everything between: today's per-job worker dispatch.
+      "pool"   everything between: per-job worker dispatch. Under
+               DPT_PIPELINE (default on) the pool layer additionally
+               ROUND-PIPELINES whatever lands on it: a worker that pops
+               a dispatch unit coalesces queue neighbors (plain singles
+               and batch groups, never leased-submesh units) up to
+               DPT_PIPELINE_DEPTH jobs and proves them staggered through
+               prover.prove_pipelined — so "batch" and "pool" traffic
+               alike fill the round pipeline, with the same byte-identity
+               contract (pool.py _run_pipeline, tests/test_pipeline.py).
 
   SubmeshLeaser
       partitions one device enumeration into disjoint leased submeshes.

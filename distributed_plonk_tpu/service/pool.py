@@ -5,7 +5,13 @@ time: a single job, or — from the placement layer — a GROUP
 (`dispatch_group`): N same-shape jobs proved together through
 `prover.prove_many` (cross-job batched kernel launches, byte-identical
 to sequential), or one job on an override backend (a leased-submesh
-MeshBackend). Every attempt runs with a `checkpoint.ProverCheckpoint`
+MeshBackend). When round pipelining is on (`DPT_PIPELINE`, the
+default), a worker that dequeues a plain unit also coalesces queue
+neighbors up to `DPT_PIPELINE_DEPTH` jobs and proves them through
+`prover.prove_pipelined`: members advance through the five round stages
+staggered, so one member's device launches overlap the others' host
+transcript/checkpoint work — still byte-identical per job. Every
+attempt runs with a `checkpoint.ProverCheckpoint`
 under the job's id, so when a worker dies mid-prove the retry does NOT
 restart at round 1: it resumes at the last completed round with the
 identical transcript/RNG state and produces the same bytes the
@@ -37,7 +43,8 @@ import queue as _stdlib_queue
 
 from ..checkpoint import ProverCheckpoint, StoreCheckpoint
 from ..obs import log as olog
-from ..prover import prove, prove_many
+from .. import prover as _prover
+from ..prover import prove, prove_many, prove_pipelined
 from ..proof_io import serialize_proof
 from ..trace import Tracer
 from . import jobs as J
@@ -403,28 +410,79 @@ class WorkerPool:
             item = self._dispatch_q.get()
             if item is _STOP:
                 return
-            if isinstance(item, _Group):
-                try:
-                    if len(item.jobs) == 1:
-                        # single-job group (a leased-submesh sharded
-                        # prove): the historical single-attempt path,
-                        # just on the override backend
-                        alive = self._run_one(worker,
-                                              item.backend or backend,
-                                              item.jobs[0], item.res)
-                    else:
-                        alive = self._run_group(worker,
-                                                item.backend or backend,
-                                                item.jobs, item.res)
-                finally:
-                    if item.release is not None:
-                        item.release(item.lease)
-                if not alive:
-                    return
-                continue
-            job, res = item
-            if not self._run_one(worker, backend, job, res):
+            if not self._run_item(worker, backend, item):
                 return
+
+    def _put_back(self, item):
+        """Return an item to the dispatch queue without ever blocking a
+        worker thread on its own queue (same hazard as _retry_or_fail:
+        workers are the consumers)."""
+        try:
+            self._dispatch_q.put_nowait(item)
+        except _stdlib_queue.Full:
+            threading.Thread(target=self._dispatch_q.put, args=(item,),
+                             daemon=True).start()
+
+    def _coalesce(self, budget):
+        """Opportunistically pop up to `budget` more JOBS' worth of
+        pipeline-eligible units (plain tuples and pool-backend groups)
+        off the dispatch queue, so mixed small/mid-shape traffic fills
+        the round pipeline instead of proving one job at depth 1 while
+        its queue neighbors wait. _STOP and override-backend (leased
+        submesh) groups are put back and end the scan — their routing is
+        per-unit."""
+        units = []
+        taken = 0
+        while taken < budget:
+            try:
+                item = self._dispatch_q.get_nowait()
+            except _stdlib_queue.Empty:
+                break
+            if item is _STOP or (isinstance(item, _Group)
+                                 and item.backend is not None):
+                self._put_back(item)
+                break
+            if isinstance(item, _Group):
+                units.append(item)
+                taken += len(item.jobs)
+            else:
+                units.append(_Group([item[0]], item[1], None, None, None))
+                taken += 1
+        return units
+
+    def _run_item(self, worker, backend, item):
+        """Route one dequeued dispatch unit. Returns False when this
+        worker thread must exit (killed slot or drain)."""
+        if isinstance(item, _Group) and item.backend is not None:
+            # leased-submesh sharded prove: the historical non-pipelined
+            # paths on the override backend — the lease is per-unit, so
+            # these units never coalesce with queue neighbors
+            try:
+                if len(item.jobs) == 1:
+                    return self._run_one(worker, item.backend,
+                                         item.jobs[0], item.res)
+                return self._run_group(worker, item.backend, item.jobs,
+                                       item.res)
+            finally:
+                if item.release is not None:
+                    item.release(item.lease)
+        units = ([item] if isinstance(item, _Group)
+                 else [_Group([item[0]], item[1], None, None, None)])
+        if _prover.PIPELINE:
+            units.extend(self._coalesce(
+                _prover.PIPELINE_DEPTH - len(units[0].jobs)))
+        try:
+            if _prover.PIPELINE and sum(len(u.jobs) for u in units) > 1:
+                return self._run_pipeline(worker, backend, units)
+            unit = units[0]
+            if len(unit.jobs) == 1:
+                return self._run_one(worker, backend, unit.jobs[0],
+                                     unit.res)
+            return self._run_group(worker, backend, unit.jobs, unit.res)
+        finally:
+            for u in units:
+                if u.release is not None:
+                    u.release(u.lease)
 
     def _run_one(self, worker, backend, job, res):
         """One single-job attempt on this worker thread. Returns False
@@ -572,6 +630,137 @@ class WorkerPool:
                 self.metrics.inc("batch_member_kills")
                 self._retry_or_fail(job, res,
                                     "batch member killed mid-prove")
+            elif isinstance(err, JobTimeout):
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "timeout"})
+                self.metrics.inc("jobs_timeout")
+                self._fail(job, f"timeout after {self.job_timeout_s}s")
+            else:
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": f"error: {err!r}"})
+                self.metrics.inc("job_attempt_errors")
+                self._retry_or_fail(job, res, f"prove failed: {err!r}")
+        worker.busy_jobs = []
+        worker.kill_arm = None
+        return True
+
+    def _pipeline_observer(self):
+        """Stage-level pipeline telemetry -> metrics: the live fill
+        gauge, the achieved-depth histogram, per-round stage-wait
+        histograms, and the device-idle estimate (host-finalize span not
+        covered by the device force — the overlap the pipeline buys)."""
+        m = self.metrics
+
+        def observe(ev):
+            r = ev["round"]
+            m.gauge("pipeline_depth", ev["depth"])
+            m.observe("pipeline_depth_achieved", ev["depth"])
+            m.observe("pipeline_stage_wait_s", ev["stage_wait_s"])
+            m.observe("pipeline_stage_wait_s/round%d" % r,
+                      ev["stage_wait_s"])
+            m.gauge("pipeline_device_idle_s/round%d" % r,
+                    ev["device_idle_s"])
+        return observe
+
+    def _run_pipeline(self, worker, backend, units):
+        """One round-pipelined attempt: the units' jobs advance through
+        the five round stages with their device launches overlapping
+        each other's host finalize work (prover.prove_pipelined), proof
+        bytes byte-identical to sequential attempts. Failure isolation
+        matches _run_group: a killed/timed-out/erroring member is
+        retried or failed ALONE (its round snapshot is durable; the
+        retry resumes it via the sequential path) while the surviving
+        members complete in this very call. Returns False when the pool
+        is draining (thread exits)."""
+        live, reses = [], []
+        for u in units:
+            for job in u.jobs:
+                if job.expired():
+                    self.shed(job, "ttl expired before prove start")
+                else:
+                    live.append(job)
+                    reses.append(u.res)
+        if not live:
+            return True
+        worker.busy_jobs = list(live)
+        for job in live:
+            if job.started_at is None:
+                job.started_at = time.monotonic()
+                self.metrics.observe("job_wait", job.wait_s)
+            job.worker = worker.name
+            job.state = J.RUNNING
+            if self.journal is not None:
+                self.journal.append(JN.START, job.id, worker=worker.name)
+        # batch_* counters keep their meaning (scheduler-formed shape
+        # batches), independent of queue-coalesced singles riding along
+        for u in units:
+            n = sum(1 for j in u.jobs if j in live)
+            if n > 1:
+                self.metrics.inc("batch_proves")
+                self.metrics.inc("batch_jobs", n)
+                self.metrics.observe("batch_jobs_per_launch", n)
+        self.metrics.inc("pipelined_proves")
+        self.metrics.inc("pipelined_jobs", len(live))
+        tracers = [self._job_tracer(worker, job) for job in live]
+        ckts = [J.build_circuit(job.spec) for job in live]
+        guards = [self._make_guard(job, worker) for job in live]
+        rngs = [random.Random(job.spec.seed) for job in live]
+        pks = [res.pk for res in reses]
+        if self.job_timeout_s is not None:
+            worker.deadline = (min(j.started_at for j in live)
+                               + self.job_timeout_s)
+        try:
+            proofs, errors = prove_pipelined(
+                rngs, ckts, pks, backend, tracers=tracers,
+                checkpoints=guards, abort_on=(WorkerDrained,),
+                observer=self._pipeline_observer())
+        except WorkerDrained:
+            # drain aborts the pipeline: every member parks at its own
+            # stage latch (snapshots durable, journal unchanged) — the
+            # restarted service resumes or re-proves deterministically
+            for job in live:
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "drained"})
+                job.state = J.QUEUED
+                job.worker = None
+                self.metrics.inc("jobs_drain_parked")
+            worker.busy_jobs = []
+            return False
+        except Exception as e:  # pipeline-wide infrastructure failure
+            for job, res in zip(live, reses):
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": f"error: {e!r}"})
+                self.metrics.inc("job_attempt_errors")
+                self._retry_or_fail(job, res,
+                                    f"pipelined prove failed: {e!r}")
+            worker.busy_jobs = []
+            worker.kill_arm = None
+            return True
+        finally:
+            worker.deadline = None
+        for job, res, tracer, ckt, proof, err in zip(live, reses, tracers,
+                                                     ckts, proofs, errors):
+            if proof is not None:
+                try:
+                    self._finish_proved(job, res, ckt, proof, tracer,
+                                        backend=backend)
+                    job.attempts.append({"worker": worker.name,
+                                         "outcome": "ok"})
+                    self.metrics.inc("jobs_completed")
+                    self.metrics.observe("job_run", job.run_s)
+                except Exception as e:  # verify/journal failure
+                    job.attempts.append({"worker": worker.name,
+                                         "outcome": f"error: {e!r}"})
+                    self.metrics.inc("job_attempt_errors")
+                    self._retry_or_fail(job, res, f"prove failed: {e!r}")
+            elif isinstance(err, WorkerKilled):
+                # job-scoped kill: only this member died; it resumes
+                # ALONE from its snapshot via the single-job retry path
+                job.attempts.append({"worker": worker.name,
+                                     "outcome": "killed"})
+                self.metrics.inc("batch_member_kills")
+                self._retry_or_fail(job, res,
+                                    "pipeline member killed mid-prove")
             elif isinstance(err, JobTimeout):
                 job.attempts.append({"worker": worker.name,
                                      "outcome": "timeout"})

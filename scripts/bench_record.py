@@ -150,7 +150,10 @@ WATCH = [
     ("trace_ctx_adopted", ("true", 0)),
     ("autoscale_canary_ok", ("true", 0)),
     ("aggregate_ok", ("true", 0)),
+    ("pipeline_byte_identical", ("true", 0)),
     # serving throughput + kernel A/Bs (ratios are basis-stable)
+    ("pipeline_speedup_vs_lockstep", ("higher", 0.4)),
+    ("pipelined_proofs_per_s", ("higher", 0.5)),
     ("proofs_per_s", ("higher", 0.5)),
     ("batch_prove_speedup_vs_sequential", ("higher", 0.4)),
     ("aggregate_verify_speedup_vs_sequential", ("higher", 0.5)),

@@ -90,7 +90,8 @@ if [ "$1" = "chaos" ]; then
     tests/test_integrity.py \
     tests/test_service_journal.py \
     tests/test_trace.py tests/test_obs.py tests/test_fleet_obs.py \
-    tests/test_placement.py tests/test_autoscale.py \
+    tests/test_placement.py tests/test_pipeline.py \
+    tests/test_autoscale.py \
     tests/test_circuits.py tests/test_aggregate.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 fi

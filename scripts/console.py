@@ -10,8 +10,11 @@ any ObsServer): service readiness (queue depth, busy workers, draining),
 the membership summary (epoch, width, suspects, open breakers), and one
 row per fleet member — reachability, breaker/suspect state, served
 request counters, live kernel gflops/MFU gauges, injected-SDC count —
-plus the /autoscale controller pane (targets, per-class queue depth,
-last 5 decisions; one quiet '(off)' line when DPT_AUTOSCALE=0) and an
+plus the round-pipeline fill pane (pipelined attempts/jobs, achieved
+depth, stage waits, per-round device-idle — parsed from /metrics; one
+quiet '(off)' line when DPT_PIPELINE=0 or nothing pipelined yet), the
+/autoscale controller pane (targets, per-class queue depth, last 5
+decisions; one quiet '(off)' line when DPT_AUTOSCALE=0) and an
 optional tail of the structured log ring (/logs). Plain ANSI,
 no curses: works over any ssh session, and --once makes it scriptable
 (the loadgen soak and tests use it as the "can an operator actually see
@@ -27,6 +30,48 @@ import urllib.request
 def _get(base, path, timeout=5):
     with urllib.request.urlopen(base + path, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _get_text(base, path, timeout=5):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _pipeline_pane(base):
+    """Round-pipeline fill pane, parsed off the Prometheus exposition
+    (/metrics is the only surface that carries the dpt_pipeline* family).
+    A daemon that never ran a pipelined attempt — or DPT_PIPELINE=0 —
+    renders as one quiet '(off)' line."""
+    try:
+        text = _get_text(base, "/metrics")
+    except Exception:
+        return ["pipeline (off)"]
+    vals = {}
+    for line in text.splitlines():
+        if not line.startswith(("dpt_pipeline", "dpt_pipelined")):
+            continue
+        name, _, raw = line.partition(" ")
+        try:
+            vals[name] = float(raw)
+        except ValueError:
+            pass
+    if not vals.get("dpt_pipelined_proves_total"):
+        return ["pipeline (off)"]
+    idle = ", ".join(
+        "r%s=%.3gs" % (k.rsplit("round", 1)[-1], v)
+        for k, v in sorted(vals.items())
+        if k.startswith("dpt_pipeline_device_idle_s_round"))
+    return [
+        "pipeline proves=%d jobs=%d depth=%g "
+        "depth_p50=%g stage_wait_p95=%.3gs" % (
+            vals.get("dpt_pipelined_proves_total", 0),
+            vals.get("dpt_pipelined_jobs_total", 0),
+            vals.get("dpt_pipeline_depth", 0),
+            vals.get('dpt_pipeline_depth_achieved_seconds'
+                     '{quantile="0.5"}', 0),
+            vals.get('dpt_pipeline_stage_wait_s_seconds'
+                     '{quantile="0.95"}', 0)),
+        "  device_idle(%s)" % (idle or "-")]
 
 
 def _fmt_member(m):
@@ -109,6 +154,7 @@ def render(base, log_tail=0):
             lines.append(f"  (no /fleet snapshot: {e})")
     else:
         lines.append("fleet    (none attached)")
+    lines.extend(_pipeline_pane(base))
     lines.extend(_autoscale_pane(base))
     if log_tail:
         try:

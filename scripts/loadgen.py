@@ -62,6 +62,39 @@ def _job_mix(args):
     return _BURST_MIX if args.mix == "burst" else _MIX
 
 
+def _pipeline_summary(m):
+    """Round-pipeline section for a soak summary: how full the pipeline
+    actually ran (achieved-depth histogram), where members stalled
+    (per-round stage-wait breakdown), and the per-round device-idle
+    estimate. `{"enabled": False}` when nothing pipelined (DPT_PIPELINE=0
+    or all traffic went down the single/batch/mesh paths)."""
+    sc = m.get("counters") or {}
+    if not sc.get("pipelined_proves"):
+        return {"enabled": False}
+    hg = m.get("histograms") or {}
+    gg = m.get("gauges") or {}
+    depth = hg.get("pipeline_depth_achieved") or {}
+    return {
+        "enabled": True,
+        "proves": sc.get("pipelined_proves", 0),
+        "jobs": sc.get("pipelined_jobs", 0),
+        "depth": {k: depth.get(k) for k in
+                  ("count", "mean_s", "p50_s", "p95_s", "max_s")
+                  if k in depth},
+        "stage_stalls": {
+            name.rsplit("/", 1)[-1]: {
+                "count": h.get("count", 0), "p50_s": h.get("p50_s"),
+                "p95_s": h.get("p95_s"), "max_s": h.get("max_s")}
+            for name, h in sorted(hg.items())
+            if name.startswith("pipeline_stage_wait_s/")
+            and h.get("count")},
+        "device_idle_s": {
+            name.rsplit("/", 1)[-1]: v
+            for name, v in sorted(gg.items())
+            if name.startswith("pipeline_device_idle_s/")},
+    }
+
+
 def _verify_result(header, blob, key_cache, lock):
     from distributed_plonk_tpu.proof_io import deserialize_proof
     from distributed_plonk_tpu.service.jobs import (JobSpec,
@@ -291,6 +324,7 @@ def run_circuit_mix_soak(args):
         "kinds": per_kind,
         "aggregate": agg_report,
         "aggregates_built": sc.get("aggregates_built", 0),
+        "pipeline": _pipeline_summary(metrics),
     }
     if args.record:
         here = os.path.dirname(os.path.abspath(__file__))
@@ -561,6 +595,7 @@ def run_traffic_soak(args):
             "worker_flap_capped": fc.get("worker_flap_capped", 0),
             "final_state": asc_state,
         },
+        "pipeline": _pipeline_summary(svc_metrics),
     }
     if args.record:
         here = os.path.dirname(os.path.abspath(__file__))
@@ -1140,6 +1175,9 @@ def main():
             "placement": {k: v for k, v in sorted(ctr.items())
                           if k.startswith("placement_")},
         },
+        # round-pipeline fill achieved by this run's traffic (achieved
+        # depth, per-round stage stalls + device-idle estimates)
+        "pipeline": _pipeline_summary(metrics),
         # chaos soak report: what was injected, what the service survived
         # (every proof above still had to verify for ok=true)
         "chaos": {
