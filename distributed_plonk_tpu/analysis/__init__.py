@@ -1,21 +1,35 @@
 """Static verifier for the kernel + serving stack.
 
-Two passes (see ISSUE/README "Static analysis"):
+Three passes (see README "Static analysis"):
 
 - `bounds`: jaxpr abstract interpretation — per-value integer magnitude
   intervals over every registered production kernel, proving no-u32-
   overflow, float exactness, and dtype discipline; plus the
   machine-checked zero-carry contracts (field_jax.CARRY_CONTRACTS).
+- `values`: exact jaxpr evaluation (arbitrary-precision host ints) of
+  each registry entry's VALUE contract — mont_mul == a*b*R^-1 mod p,
+  the NTT == the polynomial oracle, digit recombination, Horner — at
+  seeded + corner sample points. Bounds prove machine == exact integer
+  semantics; values prove exact semantics == the algebraic claim. The
+  two passes are complementary: a dropped carry lane that keeps every
+  limb in range is invisible to intervals and caught here.
 - `lint`: AST-level repo hazard lints — jit-cache keys, Python-scalar /
-  float promotion into traced code, lock discipline in service/+store/.
+  float promotion into traced code, lock discipline (incl. the LOCK03
+  lock-order deadlock graph) across the concurrent subsystems, the
+  metric/log/env-knob glossaries, and wire-tag conformance (TAG01).
 
 `python -m distributed_plonk_tpu.analysis --strict` runs everything and
-exits nonzero on any violation; `scripts/ci.sh analyze` wraps it.
-Suppress a deliberate finding with `# analysis: ok(<reason>)` on (or
-directly above) the flagged line.
+exits nonzero on any violation; `scripts/ci.sh analyze` wraps it (add
+`--changed-only` to skip registry families whose kernel modules are
+unchanged since the last clean run). analysis/mutants.py keeps the
+verifier honest: a corpus of seeded known-bad kernel variants tier-1
+asserts are still rejected by the right pass. Suppress a deliberate
+finding with `# analysis: ok(<reason>)` on (or directly above) the
+flagged line.
 """
 
-from . import bounds, lint, registry  # noqa: F401
+from . import bounds, lint, registry, values  # noqa: F401
 from .bounds import Bound, check_fn, check_contracts, limb_rows  # noqa: F401
 from .lint import run_lints, lint_source  # noqa: F401
-from .registry import build_registry, run_bounds  # noqa: F401
+from .registry import build_registry, run_bounds, run_values  # noqa: F401
+from .values import check_value, run_exact  # noqa: F401

@@ -50,9 +50,42 @@ LOG01 structured-log subsystem glossary (same dirs as OBS01): the
     and an undocumented (or typo'd) subsystem silently forks the
     vocabulary. Derived/variable subsystems are out of scope.
 
+LOCK03 lock-acquisition order (same scope as LOCK01): a directed graph
+    over (class, lock) nodes with an edge A -> B wherever code may
+    acquire B while holding A — a nested `with self.<B>` inside
+    `with self.<A>`, a multi-item `with self.A, self.B`, or a call made
+    under A to a method (of this or any other linted class, matched by
+    method name) that acquires B. Any cycle in that may-hold-while-
+    acquiring relation is a deadlock two threads can reach by taking
+    the locks in opposite orders; a self-edge on a plain Lock (not
+    RLock) is the single-thread re-entry deadlock. `Condition(lock)`
+    aliases the wrapped lock. Cross-class edges are name-matched (no
+    type inference), so a shared method name can over-approximate — a
+    pragma on any edge of a reported cycle breaks the cycle.
+
+ENV01 knob glossary (whole package): every string literal naming a
+    `DPT_*` environment knob must appear in the knob glossary held in
+    constants.py's module docstring (same indented name-column format
+    as the OBS01 metric glossary; a `DPT_FAMILY_*` token documents a
+    family). The glossary is the single source of truth operators get
+    for the ~100 knobs accreted across PRs; an undocumented knob is
+    configuration surface nobody can discover. Derived names
+    (`"DPT_TTL_%s_S" % cls`) are out of scope — document the wildcard.
+
+TAG01 wire-tag conformance (repo-wide): every tag in
+    runtime/protocol.py's TAG_NAMES table must be referenced by at
+    least one encode/decode/dispatch site in the package outside
+    protocol.py, AND by at least one test under tests/ (the old-peer
+    ERR-degradation/back-compat reference) — a new JOIN/LEAVE/
+    AGGREGATE-style tag that lands without a test for how old peers
+    degrade is exactly how a fleet rolls into a protocol split. The
+    tag table is read by AST, so the lint never imports the native
+    codec module.
+
 Suppression: append `# analysis: ok(<reason>)` to the flagged line (or
 the line above) — deliberate exceptions stay visible and reasoned at
-the site. Pragmas are honored by every lint.
+the site. Pragmas are honored by every lint (for LOCK03, on any edge
+of the cycle; for TAG01, on the tag's assignment line in protocol.py).
 """
 
 import ast
@@ -67,16 +100,21 @@ _PKG = os.path.join(_REPO, "distributed_plonk_tpu")
 # modules whose code is (or stages) traced kernels: the promotion and
 # jit-cache lints run here
 KERNEL_DIRS = ("backend", "parallel", "runtime")
-# modules with cross-thread shared state: the lock lint runs here
+# modules with cross-thread shared state: the lock lints run here
 # (runtime/ added with the fleet fault domain: LivenessTracker state,
 # WorkerState task tables, peer-connection caches are all cross-thread;
 # obs/ added with the fleet observability plane: the log ring and the
-# scraper's latest-snapshot state are cross-thread too)
-LOCK_DIRS = ("service", "store", "runtime", "obs")
+# scraper's latest-snapshot state are cross-thread too; prover.py /
+# circuits/ / aggregate.py added with ISSUE 19 — PipelinedProver and
+# the aggregation plane run under the pool's threads and had never
+# been linted. Entries ending in ".py" are single top-level modules.)
+LOCK_DIRS = ("service", "store", "runtime", "obs", "circuits",
+             "prover.py", "aggregate.py")
 # modules that record metrics into the shared registry: the OBS01
 # glossary lint runs here; LOG01 (structured-log subsystem glossary)
 # shares the same scope
-OBS_DIRS = ("service", "store", "runtime", "obs")
+OBS_DIRS = ("service", "store", "runtime", "obs", "circuits",
+            "prover.py", "aggregate.py")
 
 # mutating container-method names treated as writes by LOCK01 (calls on
 # self.<attr>.<name>(...)); read-only or thread-safe APIs (queue.put,
@@ -465,6 +503,308 @@ def _lint_locks(tree, path, src, findings):
                     f"the lock in {method}()"))
 
 
+# --- LOCK03: lock-acquisition-order graph -------------------------------------
+
+# lock-object methods: calls on these never descend into user code, so a
+# held call to them is not an acquisition edge
+_LOCK_OBJ_METHODS = {"acquire", "release", "locked", "notify", "notify_all",
+                     "wait", "wait_for"}
+
+# method names that collide with builtin container/string/IO protocols:
+# excluded from cross-class NAME matching (a held `d.get(k)` on a plain
+# dict must not edge into every class exposing a locked `get`). A held
+# call through one of these names onto a real linted object is the
+# lint's known blind spot — such APIs get reviewed manually.
+_GENERIC_METHODS = {"get", "put", "pop", "popitem", "keys", "values",
+                    "items", "update", "setdefault", "clear", "copy",
+                    "append", "extend", "insert", "remove", "sort",
+                    "index", "count", "add", "discard", "split", "join",
+                    "strip", "format", "encode", "decode", "read",
+                    "write", "close", "flush", "readline", "seek",
+                    "load", "loads", "dump", "dumps", "send", "recv"}
+
+
+def _lock_kinds(cls):
+    """({attr: 'Lock'|'RLock'|'Condition'}, {alias_attr: lock_attr}) for
+    a class: attrs assigned threading.Lock()/RLock()/Condition() anywhere
+    in the class body. `Condition(self._lock)` does not mint a new lock —
+    acquiring the condition IS acquiring the wrapped lock, so it is
+    recorded as an alias."""
+    kinds, aliases = {}, {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                (f.id if isinstance(f, ast.Name) else None)
+            if name not in ("Lock", "RLock", "Condition"):
+                continue
+            wrapped = _self_attr(node.value.args[0]) \
+                if name == "Condition" and node.value.args else None
+            for t in node.targets:
+                attr = _self_attr(t)
+                if not attr:
+                    continue
+                if wrapped is not None:
+                    aliases[attr] = wrapped
+                else:
+                    kinds[attr] = name
+    # an alias of an unknown lock (Condition over a parameter) counts as
+    # its own plain lock
+    for a, w in list(aliases.items()):
+        if w not in kinds:
+            del aliases[a]
+            kinds[a] = "Condition"
+    return kinds, aliases
+
+
+def _collect_lock_graph(tree, path, src):
+    """Per-class acquisition records for LOCK03 from one module. The
+    graph itself is assembled globally (cross-file, cross-class) by
+    _lock_graph_findings once every module in scope is collected."""
+    pragmas = _pragma_lines(src)
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        kinds, aliases = _lock_kinds(cls)
+        if not kinds:
+            continue
+        rec = {"name": cls.name, "path": path, "pragmas": pragmas,
+               "kinds": kinds, "methods": {}}
+        for m in cls.body:
+            if not isinstance(m, ast.FunctionDef):
+                continue
+
+            def canon(expr_attr):
+                return aliases.get(expr_attr, expr_attr)
+
+            ranges = {}  # lock attr -> [(body start, body end)]
+            for node in ast.walk(m):
+                if not isinstance(node, ast.With) or not node.body:
+                    continue
+                end = max(getattr(n, "end_lineno", n.lineno)
+                          for n in node.body)
+                for item in node.items:
+                    attr = canon(_self_attr(item.context_expr))
+                    if attr in kinds:
+                        ranges.setdefault(attr, []).append(
+                            (node.body[0].lineno, end))
+
+            def held(line):
+                return {a for a, rs in ranges.items()
+                        if any(s <= line <= e for s, e in rs)}
+
+            with_edges, held_calls, self_calls, attr_calls = [], [], [], []
+            for node in ast.walk(m):
+                if isinstance(node, ast.With):
+                    h, here = held(node.lineno), []
+                    for item in node.items:
+                        attr = canon(_self_attr(item.context_expr))
+                        if attr not in kinds:
+                            continue
+                        for prev in sorted(h) + here:
+                            with_edges.append((prev, attr, node.lineno))
+                        here.append(attr)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr not in _LOCK_OBJ_METHODS:
+                    is_self = isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self"
+                    # cross-class candidates are SIMPLE chains only —
+                    # `obj.m()` / `self.attr.m()`; a subscripted chain
+                    # (`self._table[k].get(...)`) is container traffic,
+                    # and name-matching dict/list protocol calls against
+                    # class APIs would flood the graph with false edges
+                    simple = isinstance(node.func.value,
+                                        (ast.Name, ast.Attribute))
+                    if is_self:
+                        self_calls.append((node.func.attr, node.lineno))
+                    elif simple:
+                        attr_calls.append((node.func.attr, node.lineno))
+                    h = held(node.lineno)
+                    if h and (is_self or simple):
+                        held_calls.append((node.func.attr, is_self,
+                                           frozenset(h), node.lineno))
+            rec["methods"][m.name] = {
+                "direct": set(ranges), "with_edges": with_edges,
+                "held_calls": held_calls, "self_calls": self_calls,
+                "attr_calls": attr_calls}
+        out.append(rec)
+    return out
+
+
+def _lock_graph_findings(class_infos):
+    """Assemble the global may-hold-while-acquiring graph and report one
+    LOCK03 finding per cycle (strongly connected component, or self-edge
+    on a non-reentrant lock)."""
+    # per-class transitive acquires: locks a method may take through its
+    # intra-class self-call closure (fixpoint); the same closure carries
+    # the method names it calls on OTHER objects, so a helper invoked
+    # under a lock still contributes its outbound cross-class calls
+    for rec in class_infos:
+        methods = rec["methods"]
+        trans = {n: set(m["direct"]) for n, m in methods.items()}
+        ext = {n: {c for c, _l in m["attr_calls"]}
+               for n, m in methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for n, m in methods.items():
+                for callee, _line in m["self_calls"]:
+                    extra = trans.get(callee, set()) - trans[n]
+                    extra_ext = ext.get(callee, set()) - ext[n]
+                    if extra or extra_ext:
+                        trans[n] |= extra
+                        ext[n] |= extra_ext
+                        changed = True
+        rec["trans"] = trans
+        rec["ext"] = ext
+
+    # method-name index for cross-class edges (no type inference: a held
+    # call `obj.submit(...)` edges into every linted class whose `submit`
+    # may acquire a lock)
+    by_method = {}
+    for rec in class_infos:
+        for mname, acquired in rec["trans"].items():
+            if acquired:
+                by_method.setdefault(mname, []).append((rec, acquired))
+
+    def name_targets(callee):
+        if callee in _GENERIC_METHODS:
+            return []
+        return [(rec2, lock) for rec2, locks in by_method.get(callee, ())
+                for lock in locks]
+
+    edges = {}  # (src, dst) -> (path, line, suppressed)
+
+    def add_edge(src_rec, src_attr, dst_node, line, path, pragmas):
+        src = (src_rec["name"], src_attr)
+        if src == dst_node \
+                and src_rec["kinds"].get(src_attr) == "RLock":
+            return  # re-entrant re-acquisition is fine
+        key = (src, dst_node)
+        if key not in edges:
+            edges[key] = (path, line, _suppressed(pragmas, line))
+
+    for rec in class_infos:
+        for m in rec["methods"].values():
+            for a, b, line in m["with_edges"]:
+                add_edge(rec, a, (rec["name"], b), line,
+                         rec["path"], rec["pragmas"])
+            for callee, is_self, held, line in m["held_calls"]:
+                # name matches back into the SAME class are dropped: the
+                # receiver is not self (a helper object whose method name
+                # collides with the class API — Histogram.snapshot vs
+                # Metrics.snapshot), and intra-class edges are already
+                # covered precisely by the self./trans path
+                if is_self:
+                    # everything the callee may acquire: its own class's
+                    # locks plus its outbound calls' name matches
+                    targets = [(rec["name"], lock)
+                               for lock in rec["trans"].get(callee, ())]
+                    for name in rec["ext"].get(callee, ()):
+                        targets += [(r2["name"], lock)
+                                    for r2, lock in name_targets(name)
+                                    if r2 is not rec]
+                else:
+                    targets = [(r2["name"], lock)
+                               for r2, lock in name_targets(callee)
+                               if r2 is not rec]
+                for h in held:
+                    for dst in targets:
+                        add_edge(rec, h, dst, line,
+                                 rec["path"], rec["pragmas"])
+
+    graph = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+        graph.setdefault(dst, set())
+
+    # Tarjan SCC (graphs here are tiny; recursion depth is bounded by
+    # the node count)
+    index_of, low, stack, on_stack, sccs = {}, {}, [], set(), []
+
+    def strongconnect(v, counter=[0]):
+        index_of[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in graph.get(v, ()):
+            if w not in index_of:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index_of[w])
+        if low[v] == index_of[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            sccs.append(comp)
+
+    for v in graph:
+        if v not in index_of:
+            strongconnect(v)
+
+    findings = []
+    for comp in sccs:
+        comp_set = set(comp)
+        if len(comp) == 1:
+            v = comp[0]
+            if (v, v) not in edges:
+                continue
+            cycle = [v, v]
+        else:
+            # shortest representative cycle from one node back to itself
+            # through the component
+            start = min(comp_set)
+            prev, frontier, seen = {}, [start], {start}
+            cycle = None
+            while frontier and cycle is None:
+                nxt = []
+                for u in frontier:
+                    for w in graph.get(u, ()):
+                        if w == start:
+                            cycle = [start]
+                            node = u
+                            while node != start:
+                                cycle.append(node)
+                                node = prev[node]
+                            cycle.append(start)
+                            cycle.reverse()
+                            break
+                        if w in comp_set and w not in seen:
+                            seen.add(w)
+                            prev[w] = u
+                            nxt.append(w)
+                    if cycle:
+                        break
+                frontier = nxt
+            if cycle is None:
+                continue  # unreachable for a true SCC
+        sites = [edges[(cycle[i], cycle[i + 1])]
+                 for i in range(len(cycle) - 1)]
+        if any(sup for _p, _l, sup in sites):
+            continue  # a pragma on any edge breaks the cycle
+        names = " -> ".join(f"{c}.{a}" for c, a in cycle)
+        where = "; ".join(f"{os.path.relpath(p, _REPO)}:{line}"
+                          for p, line, _s in sites)
+        path, line, _s = sites[0]
+        if len(cycle) == 2 and cycle[0] == cycle[1]:
+            msg = (f"non-reentrant lock {names.split(' -> ')[0]} may be "
+                   f"re-acquired while already held (self-deadlock); "
+                   f"acquisition sites: {where}")
+        else:
+            msg = (f"lock-order cycle {names}: two threads taking these "
+                   f"locks in opposite orders deadlock; acquisition "
+                   f"sites: {where}")
+        findings.append(Finding(path, line, "LOCK03", msg))
+    return findings
+
+
 # --- OBS01: metric-name glossary ----------------------------------------------
 
 _GLOSSARY_PATH = os.path.join(_PKG, "service", "metrics.py")
@@ -568,6 +908,134 @@ def _lint_log_subsystems(tree, path, src, findings, subsystems):
             "structured logs keep one vocabulary"))
 
 
+# --- ENV01: DPT_* knob glossary -----------------------------------------------
+
+_KNOB_GLOSSARY_PATH = os.path.join(_PKG, "constants.py")
+_KNOB_RE = re.compile(r"DPT_[A-Z0-9_]+")
+_KNOB_TOKEN_RE = re.compile(r"DPT_[A-Z0-9_]*\*?")
+
+
+def parse_knob_glossary(doc):
+    """(exact names, wildcard prefixes) from the knob glossary held in a
+    module docstring — same shape as the OBS01 metric glossary: only the
+    NAME COLUMN of indented lines is read (name separated from the
+    description by >= 2 spaces), and a `DPT_FAMILY_*` token documents
+    every knob under that prefix."""
+    exact, prefixes = set(), []
+    for line in doc.splitlines():
+        if not line.startswith("    ") or not line.strip():
+            continue
+        name_col = re.split(r"\s{2,}", line.strip(), maxsplit=1)[0]
+        for tok in _KNOB_TOKEN_RE.findall(name_col):
+            if tok.endswith("*"):
+                prefixes.append(tok[:-1])
+            else:
+                exact.add(tok)
+    return exact, tuple(prefixes)
+
+
+def _load_knob_glossary():
+    with open(_KNOB_GLOSSARY_PATH) as f:
+        tree = ast.parse(f.read(), filename=_KNOB_GLOSSARY_PATH)
+    return parse_knob_glossary(ast.get_docstring(tree) or "")
+
+
+def _knob_documented(name, glossary):
+    exact, prefixes = glossary
+    return name in exact or any(name.startswith(p) for p in prefixes)
+
+
+def _lint_env_knobs(tree, path, src, findings, glossary):
+    """Every standalone string literal naming a DPT_* knob (env reads,
+    helper-wrapped reads, registry patch targets) must be documented.
+    Only whole-literal matches count, so prose mentioning a knob inside
+    a docstring or message never false-passes OR false-fails."""
+    pragmas = _pragma_lines(src)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _KNOB_RE.fullmatch(node.value)):
+            continue
+        if _knob_documented(node.value, glossary) \
+                or _suppressed(pragmas, node.lineno):
+            continue
+        findings.append(Finding(
+            path, node.lineno, "ENV01",
+            f"knob {node.value!r} is read here but absent from the "
+            "constants.py knob glossary — document it (or a matching "
+            "`DPT_FAMILY_*` wildcard) so operators can discover it"))
+
+
+# --- TAG01: wire-tag conformance ----------------------------------------------
+
+_PROTOCOL_PATH = os.path.join(_PKG, "runtime", "protocol.py")
+_TESTS_DIR = os.path.join(_REPO, "tests")
+# mirrors protocol.py's TAG_NAMES comprehension (non-tag uppercase ints)
+_NON_TAG_CONSTS = ("FR_BYTES", "FQ_BYTES", "POINT_BYTES")
+
+
+def _protocol_tags():
+    """{tag name: assignment line}, replicated from protocol.TAG_NAMES'
+    comprehension by AST so the lint never imports the native codec."""
+    with open(_PROTOCOL_PATH) as f:
+        tree = ast.parse(f.read(), filename=_PROTOCOL_PATH)
+    consts = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.isupper() \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            consts[node.targets[0].id] = (node.value.value, node.lineno)
+    err = consts.get("ERR", (101, 0))[0]
+    return {name: line for name, (value, line) in consts.items()
+            if 0 < value <= err and name not in _NON_TAG_CONSTS}
+
+
+def _tag_refs_in(tree, tags):
+    """Tag names referenced by this module (protocol.NAME attribute
+    access or a bare NAME from-import use)."""
+    refs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in tags:
+            refs.add(node.attr)
+        elif isinstance(node, ast.Name) and node.id in tags:
+            refs.add(node.id)
+    return refs
+
+
+def _tag_findings(tags, code_refs):
+    """TAG01 findings: tags with no package encode/decode site or no
+    test reference. `code_refs` = tag names seen in package code outside
+    protocol.py."""
+    with open(_PROTOCOL_PATH) as f:
+        src = f.read()
+    pragmas = _pragma_lines(src)
+    test_blob = []
+    if os.path.isdir(_TESTS_DIR):
+        for fname in sorted(os.listdir(_TESTS_DIR)):
+            if fname.endswith(".py"):
+                with open(os.path.join(_TESTS_DIR, fname)) as f:
+                    test_blob.append(f.read())
+    test_blob = "\n".join(test_blob)
+    findings = []
+    for name, line in sorted(tags.items(), key=lambda kv: kv[1]):
+        if _suppressed(pragmas, line):
+            continue
+        missing = []
+        if name not in code_refs:
+            missing.append("encode/decode site in the package")
+        if not re.search(rf"\b{name}\b", test_blob):
+            missing.append("back-compat test reference under tests/")
+        if missing:
+            findings.append(Finding(
+                _PROTOCOL_PATH, line, "TAG01",
+                f"wire tag {name} has no {' and no '.join(missing)} — "
+                "every protocol tag needs a live codec site and an "
+                "old-peer degradation test before it ships"))
+    return findings
+
+
 # --- driver -------------------------------------------------------------------
 
 def _module_globals(tree):
@@ -589,8 +1057,14 @@ def _module_globals(tree):
 
 
 def _iter_py(root, subdirs):
+    """Yield .py files under each subdir; an entry ending in ".py" is a
+    single top-level module (prover.py / aggregate.py)."""
     for sub in subdirs:
         d = os.path.join(root, sub)
+        if sub.endswith(".py"):
+            if os.path.isfile(d):
+                yield d
+            continue
         if not os.path.isdir(d):
             continue
         for fname in sorted(os.listdir(d)):
@@ -598,19 +1072,34 @@ def _iter_py(root, subdirs):
                 yield os.path.join(d, fname)
 
 
+def _iter_py_all(root):
+    """Every .py file in the package (the ENV01/TAG01 scope)."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
 def run_lints(pkg_root=_PKG):
-    """All lints over their target directories. Returns [Finding]."""
+    """All lints over their target scopes. Returns [Finding]."""
     findings = []
-    seen = set()
     glossary = _load_glossary()
     log_glossary = _load_log_glossary()
-    for path in _iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS + OBS_DIRS):
-        if path in seen:
-            continue
-        seen.add(path)
+    knob_glossary = _load_knob_glossary()
+    tags = _protocol_tags()
+    scoped = set(_iter_py(pkg_root, KERNEL_DIRS + LOCK_DIRS + OBS_DIRS))
+    lock_classes, tag_refs = [], set()
+    for path in _iter_py_all(pkg_root):
         with open(path) as f:
             src = f.read()
         tree = ast.parse(src, filename=path)
+        # package-wide scopes: knob glossary + tag reference collection
+        _lint_env_knobs(tree, path, src, findings, knob_glossary)
+        if os.path.normpath(path) != os.path.normpath(_PROTOCOL_PATH):
+            tag_refs |= _tag_refs_in(tree, tags)
+        if path not in scoped:
+            continue
         rel = os.path.relpath(path, pkg_root)
         top = rel.split(os.sep)[0]
         if top in KERNEL_DIRS:
@@ -619,18 +1108,25 @@ def run_lints(pkg_root=_PKG):
             _lint_promotion(tree, path, src, findings)
         if top in LOCK_DIRS:
             _lint_locks(tree, path, src, findings)
+            lock_classes += _collect_lock_graph(tree, path, src)
         if top in OBS_DIRS:
             _lint_obs(tree, path, src, findings, glossary)
             _lint_log_subsystems(tree, path, src, findings, log_glossary)
+    findings += _lock_graph_findings(lock_classes)
+    findings += _tag_findings(tags, tag_refs)
     return findings
 
 
 def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
-                glossary_doc=None, log_glossary_doc=None):
+                glossary_doc=None, log_glossary_doc=None,
+                knob_glossary_doc=None):
     """Lint one source string (unit tests / editor integration).
     glossary_doc: docstring text for the "obs" kind (defaults to the
     real service/metrics.py glossary); log_glossary_doc likewise for
-    the "log" kind (defaults to the real obs/log.py glossary)."""
+    the "log" kind (defaults to the real obs/log.py glossary);
+    knob_glossary_doc likewise for the "env" kind (defaults to the real
+    constants.py knob glossary). The "lock" kind runs LOCK01/LOCK02 and
+    the LOCK03 order graph over the classes in this one source string."""
     findings = []
     tree = ast.parse(src, filename=path)
     if "jit" in kinds:
@@ -639,6 +1135,8 @@ def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
         _lint_promotion(tree, path, src, findings)
     if "lock" in kinds:
         _lint_locks(tree, path, src, findings)
+        findings += _lock_graph_findings(
+            _collect_lock_graph(tree, path, src))
     if "obs" in kinds:
         glossary = parse_glossary(glossary_doc) \
             if glossary_doc is not None else _load_glossary()
@@ -647,4 +1145,8 @@ def lint_source(src, path="<string>", kinds=("jit", "prom", "lock"),
         subsystems = parse_log_glossary(log_glossary_doc) \
             if log_glossary_doc is not None else _load_log_glossary()
         _lint_log_subsystems(tree, path, src, findings, subsystems)
+    if "env" in kinds:
+        knobs = parse_knob_glossary(knob_glossary_doc) \
+            if knob_glossary_doc is not None else _load_knob_glossary()
+        _lint_env_knobs(tree, path, src, findings, knobs)
     return findings

@@ -28,6 +28,7 @@ machine running the check.
 """
 
 from . import bounds as B
+from . import values as V
 from .bounds import Bound, limb_rows
 
 import jax.numpy as jnp
@@ -37,24 +38,300 @@ U16 = (1 << 16) - 1
 U32 = (1 << 32) - 1
 
 
+class ValueObligation:
+    """A machine-checked value contract for a registry entry.
+
+    sampler(rng) -> concrete args; contract(args, outs) -> error
+    strings.  `fn` overrides the entry fn when the value pass needs a
+    cheaper instantiation of the same kernel code (e.g. a smaller Horner
+    chunk); `patches` are applied ON TOP of the entry's bounds patches
+    (e.g. a narrow Pallas lane tile so the exact grid walk stays cheap —
+    the kernel body is tile-width-generic, which the bounds pass proves
+    at the real tile)."""
+
+    def __init__(self, sampler, contract, samples=1, patches=(), fn=None):
+        self.sampler = sampler
+        self.contract = contract
+        self.samples = samples
+        self.patches = tuple(patches)
+        self.fn = fn
+
+
 class Entry:
-    def __init__(self, name, fn, args, out_bounds=None, patches=()):
+    def __init__(self, name, fn, args, out_bounds=None, patches=(),
+                 value=None):
         self.name = name
         self.fn = fn
         self.args = args
         self.out_bounds = out_bounds
         self.patches = tuple(patches)  # ((module, attr, value), ...)
+        self.value = value             # ValueObligation | None
 
-    def check(self, strict=True):
-        saved = [(m, a, getattr(m, a)) for m, a, _ in self.patches]
-        for m, a, v in self.patches:
+    def _patched(self, patches, thunk):
+        saved = [(m, a, getattr(m, a)) for m, a, _ in patches]
+        for m, a, v in patches:
             setattr(m, a, v)
         try:
-            return B.check_fn(self.name, self.fn, self.args,
-                              out_bounds=self.out_bounds, strict=strict)
+            return thunk()
         finally:
             for m, a, v in saved:
                 setattr(m, a, v)
+
+    def check(self, strict=True):
+        return self._patched(
+            self.patches,
+            lambda: B.check_fn(self.name, self.fn, self.args,
+                               out_bounds=self.out_bounds, strict=strict))
+
+    def check_values(self, strict=True, seed=0):
+        """Run this entry's value contract (None when the entry declares
+        no value obligation — e.g. curve group ops, whose value story is
+        the field contracts they are composed from plus parity tests)."""
+        if self.value is None:
+            return None
+        ob = self.value
+        return self._patched(
+            self.patches + ob.patches,
+            lambda: V.check_value(self.name, ob.fn or self.fn,
+                                  ob.sampler, ob.contract,
+                                  samples=ob.samples, seed=seed,
+                                  strict=strict))
+
+
+# -- value samplers / contracts ------------------------------------------------
+#
+# Sample points are seeded-random field elements PLUS the corner values
+# 0, 1, p-1 in fixed lanes: the injected bug classes (dropped carry
+# lane, off-by-one limb shift, wrong modulus constant, swapped twiddle
+# row) each change the computed value at almost every point, so a
+# handful of samples rejects them — while the corners pin the
+# conditional-subtract / carry-out edges random sampling would miss.
+
+def _fe_lane_vals(rng, p, lanes):
+    vals = [0, 1, p - 1][:lanes]
+    return vals + [V.rand_fe(rng, p) for _ in range(lanes - len(vals))]
+
+
+def _field_sampler(spec, nargs, lanes=5):
+    L = spec.n_limbs
+
+    def sample(rng):
+        args = []
+        for _ in range(nargs):
+            vals = _fe_lane_vals(rng, spec.mod, lanes)
+            rng.shuffle(vals)  # corners meet corners across samples
+            args.append(np.stack([V.limbs_from_int(v, L) for v in vals],
+                                 axis=1))
+        return tuple(args)
+    return sample
+
+
+def _mod_contract(spec, op):
+    """value(out) as a function of value(in) mod p, plus canonicality
+    (out < p) — the algebraic claim each field kernel's docstring
+    makes, now machine-checked."""
+    p, R = spec.mod, V.mont_r(spec)
+    rinv = pow(R, -1, p)
+    fns = {
+        "mont_mul": lambda a, b: a * b * rinv % p,
+        "add": lambda a, b: (a + b) % p,
+        "sub": lambda a, b: (a - b) % p,
+        "neg": lambda a: -a % p,
+        "to_mont": lambda a: a * R % p,
+        "from_mont": lambda a: a * rinv % p,
+    }
+    fn = fns[op]
+    nargs = fn.__code__.co_argcount
+
+    def contract(args, outs):
+        ins = [V.limb_value(V.to_exact(a)) for a in args[:nargs]]
+        want = V.elementwise(lambda *vs: fn(*[int(x) for x in vs]), *ins)
+        got = V.limb_value(outs[0])
+        errs = V.mismatch_report(f"value(out) == {op}(value(in)) mod p",
+                                 got, want)
+        over = sum(int(g) >= p for g in got.reshape(-1))
+        if over:
+            errs.append(f"{op}: output not canonical (>= p) in "
+                        f"{over} lane(s)")
+        return errs
+    return contract
+
+
+def _field_value(spec, op, nargs, lanes=5, samples=2, patches=(),
+                 fn=None):
+    return ValueObligation(_field_sampler(spec, nargs, lanes),
+                           _mod_contract(spec, op), samples=samples,
+                           patches=patches, fn=fn)
+
+
+def _carry_sweep_value():
+    def sampler(rng):
+        cols = rng.integers(0, 1 << 32, size=(16, 6), dtype=np.uint32)
+        cols[:, 0] = 0          # corner: all-zero columns
+        cols[:, 1] = U32        # corner: every column saturated
+        return (cols,)
+
+    def contract(args, outs):
+        K = args[0].shape[0]
+        vc = V.limb_value(V.to_exact(args[0]))
+        vl = V.limb_value(outs[0])
+        carry = V.elementwise(lambda c: int(c) << (16 * K), outs[1])
+        return V.mismatch_report(
+            "value(limbs) + carry*2^(16K) == value(cols)",
+            vl + carry, vc)
+    return ValueObligation(sampler, contract, samples=2)
+
+
+def _roundtrip_value(shape):
+    def sampler(rng):
+        v = rng.integers(0, 1 << 16, size=shape, dtype=np.uint32)
+        v.reshape(-1)[0] = 0
+        v.reshape(-1)[1] = U16
+        return (v,)
+
+    def contract(args, outs):
+        return V.mismatch_report("pack/unpack roundtrip identity",
+                                 outs[0], V.to_exact(args[0]))
+    return ValueObligation(sampler, contract, samples=2)
+
+
+def _cumsum_value(spec, lanes=8):
+    p, L = spec.mod, spec.n_limbs
+
+    def sampler(rng):
+        vals = _fe_lane_vals(rng, p, lanes)
+        return (np.stack([V.limbs_from_int(v, L) for v in vals],
+                         axis=1),)
+
+    def contract(args, outs):
+        vin = V.limb_value(V.to_exact(args[0]))
+        got = V.limb_value(outs[0])
+        acc, want = 0, []
+        for x in vin.reshape(-1):
+            acc = (acc + int(x)) % p
+            want.append(acc)
+        return V.mismatch_report("inclusive prefix sums mod p", got,
+                                 np.array(want, dtype=object))
+    return ValueObligation(sampler, contract, samples=2)
+
+
+def _ntt_value(n, inverse, coset, cnp, batch=False, perm=None):
+    """value(out) == DFT(value(in)) against the pure-Python poly
+    oracle.  Fr-linearity of the transform makes the oracle apply to
+    RAW limb values in both boundaries: Montgomery form is scaling by
+    R, and the DFT commutes with scalar multiplication — so no
+    boundary-specific expected values are needed.  `perm` (the
+    defer_perm consts table) relates bit-reversed outputs back to
+    natural order."""
+    from .. import poly as P
+    from ..constants import R_MOD
+    dom = P.Domain(n)
+    rows = 3 if batch else 1
+
+    def sampler(rng):
+        vals = [V.rand_fe(rng, R_MOD) for _ in range(rows * n)]
+        vals[0], vals[1] = 0, 1  # corner lanes ride every sample
+        arr = np.stack([V.limbs_from_int(v, 16) for v in vals], axis=1)
+        shape = (16, rows, n) if batch else (16, n)
+        return arr.reshape(shape), cnp
+
+    def oracle(vs):
+        if inverse and coset:
+            return P.coset_ifft(dom, vs)
+        if inverse:
+            return P.ifft(dom, vs)
+        if coset:
+            return P.coset_fft(dom, vs)
+        return P.fft(dom, vs)
+
+    def contract(args, outs):
+        vin = V.limb_value(V.to_exact(args[0])).reshape(-1, n)
+        got = V.limb_value(outs[0]).reshape(-1, n)
+        errs = []
+        for b in range(vin.shape[0]):
+            want = list(oracle([int(x) % R_MOD for x in vin[b]]))
+            row = [int(x) % R_MOD for x in got[b]]
+            if perm is not None:
+                row = [row[i] for i in perm]
+            if row != want:
+                k = next(i for i in range(n) if row[i] != want[i])
+                nbad = sum(r != w for r, w in zip(row, want))
+                errs.append(f"row {b}: mismatch vs poly oracle at lane "
+                            f"{k} ({nbad}/{n} lanes differ)")
+        return errs
+    return ValueObligation(sampler, contract, samples=1)
+
+
+def _digits_value(Lw, c, bias):
+    """Σ (digit_w - bias)·2^(c·w) reconstructs from_mont(handle)
+    exactly, per lane, zero on padding — the recombination equation the
+    bucket accumulation relies on (bias 0 = unsigned)."""
+    from ..constants import R_MOD
+    rinv = pow(1 << 256, -1, R_MOD)
+
+    def sampler(rng):
+        vals = _fe_lane_vals(rng, R_MOD, Lw)
+        return (np.stack([V.limbs_from_int(v, 16) for v in vals],
+                         axis=1),)
+
+    def contract(args, outs):
+        vin = [int(x) for x in
+               V.limb_value(V.to_exact(args[0])).reshape(-1)]
+        scal = [v * rinv % R_MOD for v in vin]
+        d = outs[0]
+        W, padded = d.shape
+        errs = []
+        for j in range(padded):
+            want = scal[j] if j < len(scal) else 0
+            rec = sum((int(d[w, j]) - bias) << (c * w) for w in range(W))
+            if rec != want:
+                errs.append(f"digit recombination wrong at lane {j}: "
+                            f"sum((d-{bias})*2^({c}w)) = {rec}, "
+                            f"scalar = {want}")
+                break
+        return errs
+    return ValueObligation(sampler, contract, samples=1)
+
+
+def _eval_value(Lc, batch=None, fn=None):
+    """value(out) == Σ c_i·z^i in raw-value terms: coeffs/point arrive
+    in Montgomery form (c_i = v_i·R⁻¹, z = vz·R⁻¹); poly_eval returns
+    the Montgomery form of p(z), poly_eval_many the canonical value."""
+    from ..constants import R_MOD
+    R = 1 << 256
+    rinv = pow(R, -1, R_MOD)
+
+    def sampler(rng):
+        def poly(vals):
+            return np.stack([V.limbs_from_int(v, 16) for v in vals],
+                            axis=1)
+        if batch:
+            ps = np.stack([poly(_fe_lane_vals(rng, R_MOD, Lc))
+                           for _ in range(batch)])
+            zs = np.stack([poly([V.rand_fe(rng, R_MOD)])
+                           for _ in range(batch)])
+            return ps, zs
+        return (poly(_fe_lane_vals(rng, R_MOD, Lc)),
+                poly([V.rand_fe(rng, R_MOD)]))
+
+    def contract(args, outs):
+        ax = 1 if batch else 0  # batched polys are (B, 16, L)
+        vin = V.limb_value(V.to_exact(args[0]), axis=ax).reshape(-1, Lc)
+        vz = V.limb_value(V.to_exact(args[1]), axis=ax).reshape(-1)
+        got = V.limb_value(outs[0]).reshape(-1)
+        errs = []
+        for b in range(vin.shape[0]):
+            cs = [int(x) * rinv % R_MOD for x in vin[b]]
+            z = int(vz[b]) * rinv % R_MOD
+            pz = 0
+            for c in reversed(cs):
+                pz = (pz * z + c) % R_MOD
+            want = pz if batch else pz * R % R_MOD  # many() -> canonical
+            if int(got[b]) != want:
+                errs.append(f"poly {b}: p(z) value mismatch: "
+                            f"got {int(got[b])}, want {want}")
+        return errs
+    return ValueObligation(sampler, contract, samples=1, fn=fn)
 
 
 def _field_entries():
@@ -71,32 +348,41 @@ def _field_entries():
             out.append(Entry(
                 f"field/{n}_mont_mul_{tag}",
                 lambda a, b, s=spec: FJ.mont_mul(s, a, b), pair,
-                limbs_out, patches=[(FJ, "_MUL_MODE", tag)]))
+                limbs_out, patches=[(FJ, "_MUL_MODE", tag)],
+                value=_field_value(spec, "mont_mul", 2)))
         out.append(Entry(f"field/{n}_add",
                          lambda a, b, s=spec: FJ.add(s, a, b), pair,
-                         limbs_out))
+                         limbs_out, value=_field_value(spec, "add", 2)))
         out.append(Entry(f"field/{n}_sub",
                          lambda a, b, s=spec: FJ.sub(s, a, b), pair,
-                         limbs_out))
+                         limbs_out, value=_field_value(spec, "sub", 2)))
         out.append(Entry(f"field/{n}_neg",
-                         lambda a, s=spec: FJ.neg(s, a), one, limbs_out))
+                         lambda a, s=spec: FJ.neg(s, a), one, limbs_out,
+                         value=_field_value(spec, "neg", 1)))
         out.append(Entry(f"field/{n}_to_mont",
                          lambda a, s=spec: FJ.to_mont(s, a), one,
-                         limbs_out))
+                         limbs_out,
+                         value=_field_value(spec, "to_mont", 1)))
         out.append(Entry(f"field/{n}_from_mont",
                          lambda a, s=spec: FJ.from_mont(s, a), one,
-                         limbs_out))
+                         limbs_out,
+                         value=_field_value(spec, "from_mont", 1)))
     # the sweep itself, at its weakest precondition (ANY u32 columns):
-    # output limbs < 2^16 and a carry bounded by hi[-1] + 1
+    # output limbs < 2^16 and a carry bounded by hi[-1] + 1; the value
+    # obligation is the EQUATION its docstring used to state as prose —
+    # value(limbs) + carry·2^(16K) == value(cols), exactly
     out.append(Entry("field/carry_sweep", FJ._carry_sweep,
                      (Bound((FJ.FR.n_limbs, 8), jnp.uint32, 0, U32),),
-                     [(0, U16), (0, 1 << 16)]))
+                     [(0, U16), (0, 1 << 16)],
+                     value=_carry_sweep_value()))
     out.append(Entry("field/pack_unpack_limb_pairs",
                      lambda v: FJ.unpack_limb_pairs(FJ.pack_limb_pairs(v)),
-                     (limb_rows(8, 16),), [(0, U16)]))
+                     (limb_rows(8, 16),), [(0, U16)],
+                     value=_roundtrip_value((8, 16))))
     out.append(Entry("field/cumsum_mont",
                      lambda v: FJ.cumsum_mont(FJ.FR, v),
-                     (limb_rows(16, 8),), [(0, U16)]))
+                     (limb_rows(16, 8),), [(0, U16)],
+                     value=_cumsum_value(FJ.FR)))
     return out
 
 
@@ -120,10 +406,18 @@ def _field_pallas_entries():
         pair = (limb_rows(L, FP.LANE_TILE), limb_rows(L, FP.LANE_TILE))
         n = spec.name.lower()
         for variant in ("lazy", "mxu"):
+            # value obligation at a narrow lane tile (8): the kernel
+            # body is tile-width-generic (one grid step per tile of the
+            # SAME traced program — the bounds entry proves it at the
+            # real tile), so the exact grid walk stays cheap while the
+            # product contract still covers the lazy local rounds /
+            # bf16 band paths
             out.append(Entry(
                 f"field/{n}_mont_mul_pallas_{variant}",
                 lambda a, b, s=spec: FP.mont_mul(s, a, b), pair,
-                [(0, U16)], patches=[(FP, "_VARIANT", variant)]))
+                [(0, U16)], patches=[(FP, "_VARIANT", variant)],
+                value=_field_value(spec, "mont_mul", 2, lanes=8,
+                                   patches=[(FP, "LANE_TILE", 8)])))
     return out
 
 
@@ -147,10 +441,18 @@ def _ntt_entries():
                         inverse, coset, boundary=boundary, radix=4,
                         kernel="xla")
                     cnp = {k: np.asarray(v) for k, v in consts.items()}
+                    # value obligations ride the n=32 programs: the
+                    # stage pipeline is width-generic and n=64 costs
+                    # 4x in exact evaluation for the same rule set;
+                    # n=64 keeps its interval obligation plus the
+                    # batch/defer_perm value entries below
+                    val = (_ntt_value(n, inverse, coset, cnp)
+                           if n == 32 else None)
                     out.append(Entry(
                         f"ntt/n{n}_radix4_inv{int(inverse)}"
                         f"_coset{int(coset)}_{boundary}",
-                        fn, (limb_rows(16, n), cnp), [(0, U16)]))
+                        fn, (limb_rows(16, n), cnp), [(0, U16)],
+                        value=val))
         # radix-2 parity core (one mode per n keeps the sweep cheap; the
         # stage body is mode-independent modulo pre/post table muls,
         # which the inverse+coset variant includes)
@@ -158,13 +460,18 @@ def _ntt_entries():
                                         radix=2, kernel="xla")
         cnp = {k: np.asarray(v) for k, v in consts.items()}
         out.append(Entry(f"ntt/n{n}_radix2_inv1_coset1_mont", fn,
-                         (limb_rows(16, n), cnp), [(0, U16)]))
+                         (limb_rows(16, n), cnp), [(0, U16)],
+                         value=(_ntt_value(n, True, True, cnp)
+                                if n == 32 else None)))
         # batched kernel (the prover's round-1/round-3 launches)
         fn, consts = plan.traced_kernel(False, True, radix=4, batch=True,
                                         kernel="xla")
         cnp = {k: np.asarray(v) for k, v in consts.items()}
         out.append(Entry(f"ntt/n{n}_radix4_batch3_coset", fn,
-                         (limb_rows(16, 3, n), cnp), [(0, U16)]))
+                         (limb_rows(16, 3, n), cnp), [(0, U16)],
+                         value=(_ntt_value(n, False, True, cnp,
+                                           batch=True)
+                                if n == 32 else None)))
     # fused multi-stage Pallas kernel (DPT_NTT_KERNEL=pallas): the
     # pallas_call kernel jaxprs are interpreted like the fused MSM's
     # (bounds._p_pallas_call). Coverage: forward+coset (pre-scale fused
@@ -187,10 +494,15 @@ def _ntt_entries():
             NP._ROWS_CAP = saved
         cnp = {k: np.asarray(v) for k, v in consts.items()}
         shape = (16, 3, n) if batch else (16, n)
+        # the pallas programs carry value obligations at their OWN
+        # traced shape: the exact interpreter executes the grid with
+        # persistent scratch refs, so the fused-group scheduling (incl.
+        # the two-group VMEM spill path) is part of what is proven
         return Entry(
             f"ntt/n{n}_pallas_inv{int(inverse)}_coset{int(coset)}"
             + ("_batch3" if batch else "") + f"_rows{rows_cap}",
-            fn, (limb_rows(*shape), cnp), [(0, U16)])
+            fn, (limb_rows(*shape), cnp), [(0, U16)],
+            value=_ntt_value(n, inverse, coset, cnp, batch=batch))
 
     out.append(pallas_ntt(64, False, True, False, 64))   # one group, R=6
     out.append(pallas_ntt(64, True, True, False, 8))     # two groups, R=3
@@ -207,8 +519,15 @@ def _ntt_entries():
         fn, consts = plan.traced_kernel(False, True, radix=4, batch=True,
                                         kernel=kern, defer_perm=True)
         cnp = {k: np.asarray(v) for k, v in consts.items()}
+        # value obligation includes the output-order relation: the
+        # kernel's bit-reversed rows, re-ordered by its OWN consts
+        # permutation, must equal the natural-order oracle — a swapped
+        # or stale perm table is a value finding, not just a lane move
         out.append(Entry(f"ntt/n64_{tag}_batch3_coset_defer_perm", fn,
-                         (limb_rows(16, 3, 64), cnp), [(0, U16)]))
+                         (limb_rows(16, 3, 64), cnp), [(0, U16)],
+                         value=_ntt_value(64, False, True, cnp,
+                                          batch=True,
+                                          perm=np.asarray(cnp["perm"]))))
     return out
 
 
@@ -224,15 +543,18 @@ def _msm_entries():
         out.append(Entry(
             f"msm/digits_signed_c7_L{Lw}",
             lambda h: MSM.signed_digits7_from_mont(h, padded_n=2 * dom),
-            (limb_rows(16, Lw),), [(0, 127)]))
+            (limb_rows(16, Lw),), [(0, 127)],
+            value=_digits_value(Lw, 7, 64)))
         out.append(Entry(
             f"msm/digits_signed_c8_L{Lw}",
             lambda h: MSM.signed_digits_from_mont(h, padded_n=2 * dom),
-            (limb_rows(16, Lw),), [(0, 255)]))
+            (limb_rows(16, Lw),), [(0, 255)],
+            value=_digits_value(Lw, 8, 128)))
         out.append(Entry(
             f"msm/digits_unsigned_c4_L{Lw}",
             lambda h: MSM.digits_from_mont(h, 4, padded_n=2 * dom),
-            (limb_rows(16, Lw),), [(0, 15)]))
+            (limb_rows(16, Lw),), [(0, 15)],
+            value=_digits_value(Lw, 4, 0)))
 
     # bucket-update scan: signed c=7 shape (the default batched
     # pipeline), under every plane-update strategy
@@ -347,15 +669,23 @@ def _eval_entries():
 
     out = []
     for L in (256, 66):  # one full chunk; the n=64 blinded n+2 width
+        # the value obligation runs the SAME poly_eval at chunk=8 on a
+        # 20-coeff poly: 3 Horner blocks + the log-depth power combine
+        # + the padded tail are all exercised, without 256 exact scan
+        # steps per sample (chunk is a real parameter of the real fn,
+        # not a shadow implementation)
         out.append(Entry(
             f"eval/horner_at_r_n{L}",
             lambda p, z: PJ.poly_eval(p, z),
-            (limb_rows(16, L), limb_rows(16, 1)), [(0, U16)]))
+            (limb_rows(16, L), limb_rows(16, 1)), [(0, U16)],
+            value=_eval_value(
+                20, fn=lambda p, z: PJ.poly_eval(p, z, chunk=8))))
     # the batched round-4 launch shape (B polys, one point each)
     out.append(Entry(
         "eval/horner_at_r_batch4_n66",
         lambda p, z: PJ.poly_eval_many(p, z),
-        (limb_rows(4, 16, 66), limb_rows(4, 16, 1)), [(0, U16)]))
+        (limb_rows(4, 16, 66), limb_rows(4, 16, 1)), [(0, U16)],
+        value=_eval_value(5, batch=2)))
     return out
 
 
@@ -375,6 +705,26 @@ def run_bounds(strict=True, names=None, progress=None, contracts=True):
         if names is not None and not any(s in e.name for s in names):
             continue
         v = e.check(strict=strict)
+        checked += 1
+        if progress is not None:
+            progress(e.name, v)
+        violations.extend(v)
+    return violations, checked
+
+
+def run_values(strict=True, names=None, progress=None):
+    """Run every entry's value contract (entries without an obligation
+    are skipped — curve group ops and the bucket scans, whose value
+    story is the field contracts they compose plus parity tests).
+    Returns (violations, entries_checked)."""
+    violations = []
+    checked = 0
+    for e in build_registry():
+        if names is not None and not any(s in e.name for s in names):
+            continue
+        v = e.check_values(strict=strict)
+        if v is None:
+            continue
         checked += 1
         if progress is not None:
             progress(e.name, v)

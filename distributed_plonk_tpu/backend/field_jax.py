@@ -138,6 +138,18 @@ FQ = FieldSpec("Fq", Q_MOD, FQ_LIMBS, FQ_MONT_R2, FQ_MONT_INV)
 # every contract for both FieldSpecs, so a field/limb-layout change that
 # silently breaks a zero-carry assumption fails CI instead of corrupting
 # proofs. `R(spec)` below is the Montgomery radix 2^(16*L).
+#
+# These inequalities are the BOUNDS half of the story (machine arithmetic
+# == exact integer semantics). The ALGEBRAIC half — mont_mul really
+# computes a*b*R^-1 mod p, add/sub/neg/to_mont/from_mont their mod-p
+# claims, _carry_sweep the equation value(limbs) + carry*2^(16K) ==
+# value(cols) — is no longer prose either: every registered entry point
+# of this module carries a value obligation the exact-evaluation pass
+# (analysis/values.py via analysis/registry.py) checks at seeded +
+# corner sample points, on BOTH multiplier paths. A dropped carry lane
+# in the f32 path that keeps every limb in range is invisible to the
+# interval pass by construction and is caught there (the seeded-mutant
+# harness analysis/mutants.py proves that stays true).
 
 def _R(spec):
     return 1 << (LIMB_BITS * spec.n_limbs)
@@ -192,7 +204,10 @@ def _carry_sweep(cols):
     truncation); each such assumption is a named, machine-checked
     inequality in CARRY_CONTRACTS, evaluated for every FieldSpec by the
     static verifier (analysis/bounds.py::check_contracts) — do not add a
-    carry-dropping call site without extending that table.
+    carry-dropping call site without extending that table. The sweep's
+    own value equation — value(limbs) + carry*2^(16K) == value(cols),
+    exactly, for ANY u32 columns — is machine-checked too (the
+    field/carry_sweep value obligation in analysis/registry.py).
 
     Log-depth Kogge-Stone instead of a K-step ripple chain: pre-add each
     column's high bits into the next column (s_i = lo_i + hi_{i-1} < 2^17,
@@ -458,6 +473,11 @@ def mont_mul(spec, a, b):
 
     Wide shapes on TPU dispatch to the Pallas fused kernel
     (field_pallas.py) — same algorithm, intermediates in VMEM.
+
+    The claim in the first line IS the machine-checked contract: the
+    field/*_mont_mul_{f32,u32} registry entries exactly evaluate this
+    body and assert value(out) == a*b*R^-1 mod p with out < p, at
+    corner and random points, for both fields and both column paths.
     """
     if _use_pallas(jnp.broadcast_shapes(a.shape, b.shape)):
         from . import field_pallas as FP

@@ -23,7 +23,11 @@ m*p) all use the same band loop — the constant products use Python-float
 byte constants, costing a scalar*tensor FMA per band row. Carries run as
 the same log-depth Kogge-Stone sweep as field_jax._carry_sweep, on VMEM
 values. The algorithm is bit-identical to field_jax.mont_mul (same SOS
-reduction; oracle-tested in tests/test_field_pallas.py).
+reduction; oracle-tested in tests/test_field_pallas.py, and statically
+proven like the XLA paths: the field/*_mont_mul_pallas_* registry
+entries interval-check the kernel jaxpr at the real lane tile AND
+exactly evaluate the grid walk against the a*b*R^-1 mod p value
+contract — both variants, both fields).
 
 Select with DPT_FIELD_MUL=pallas (TPU; other platforms fall back to the
 f32 XLA path automatically, and tests exercise the kernel via

@@ -4,6 +4,125 @@ All values are standard, publicly specified BLS12-381 parameters (as used by
 the reference's `ark-bls12-381` dependency, see /root/reference/Cargo.toml:31).
 Derived quantities (Montgomery constants, roots of unity) are computed here
 from first principles so nothing is copied from any implementation.
+
+Runtime knob glossary (DPT_* environment variables)
+---------------------------------------------------
+The single source of truth for every environment knob the package reads,
+enforced by analysis.lint ENV01: an undocumented `DPT_*` string literal
+anywhere in the package is a lint failure. Format mirrors the OBS01 metric
+glossary — indented lines, the knob name separated from its description by
+two or more spaces; a trailing `*` documents a whole family.
+
+Kernel dispatch and device tuning (backend/, parallel/):
+
+    DPT_FIELD_MUL             field mont_mul kernel: auto|f32|u32|pallas
+    DPT_PALLAS_MIN_LANES      min lanes before the pallas mul engages (2048)
+    DPT_PALLAS_LANE_TILE      pallas mul lane-tile width (512)
+    DPT_MUL_MXU               pallas mul: use the MXU matmul core (0)
+    DPT_MUL_LAZY              pallas mul: lazy-carry accumulation (1)
+    DPT_CURVE_ADD             curve add kernel: xla|pallas (xla)
+    DPT_NTT_KERNEL            NTT kernel: auto|xla|pallas (auto)
+    DPT_NTT_RADIX             force the NTT radix (unset = auto)
+    DPT_NTT_BATCH             NTT batch width for *_many paths (8)
+    DPT_NTT_PALLAS_VMEM_MB    pallas NTT VMEM budget in MB
+    DPT_NTT_PALLAS_ROWS       pallas NTT rows per grid step
+    DPT_R3_FUSE               fuse the round-3 quotient pipeline (1)
+    DPT_R3_BITREV             consumer-side bit-reversal fusion (1)
+    DPT_QUOT_SLICE            round-3 quotient eval slice length (2^20)
+    DPT_STREAM_SYNC_EVERY     drain the dispatch queue every N FFTs (4)
+    DPT_STREAM_SYNC_MIN_M     min domain before stream draining arms (2^23)
+    DPT_RELEASE_TABLES_MIN    free circuit tables at/above this n (2^19)
+    DPT_MSM_KERNEL            MSM bucket kernel: auto|xla|pallas (auto)
+    DPT_MSM_C                 MSM window bits (7)
+    DPT_MSM_BATCH             MSM scalar batch width (8)
+    DPT_MSM_JOB_BATCH         MSM jobs folded per device dispatch (16)
+    DPT_MSM_GROUP_MAX         max MSM group size (autotune-plan override)
+    DPT_MSM_PLANE_MB          bucket-plane HBM budget in MB (1536)
+    DPT_MSM_PALLAS_VMEM_MB    pallas MSM VMEM budget in MB
+    DPT_MSM_CALL_ADDS         target bucket adds per device call (8e6)
+    DPT_MSM_CALL_ADDS_MAX     hard cap on adds per device call
+    DPT_MSM_CALL_S            target seconds per MSM device call (20)
+    DPT_BUCKET_UPDATE         bucket update strategy: auto|onehot|put
+    DPT_PLANE_PACK            packed bucket planes (1)
+    DPT_FIXED_BASE_CHUNK      fixed-base table build chunk size
+    DPT_MESH_MIN_LOCAL        min per-device rows before mesh sharding (1024)
+    DPT_MESH_LEASE            lease mesh backends to the pool (0)
+    DPT_AUTOTUNE              calibration plan mode: load|run|off (load)
+    DPT_AUTOTUNE_BUDGET_S     autotune sweep wall-clock budget (120)
+    DPT_AUTOTUNE_SHAPES       comma list of shapes to calibrate
+    DPT_AUTOTUNE_INTERPRET    allow pallas interpret-mode candidates
+    DPT_JAX_CACHE_DIR         persistent compile-cache directory
+    DPT_JAX_TRACE             jax.profiler span annotations on hot paths
+
+Proof service and autoscaling (service/):
+
+    DPT_PIPELINE              round-pipelined multi-job proving (1)
+    DPT_PIPELINE_DEPTH        max in-flight pipelined jobs (4)
+    DPT_BATCH_PROVE           shape-batched proving (1)
+    DPT_PLACE_SMALL_MAX       small-job placement cutoff, gates (2^14)
+    DPT_PLACE_LARGE_MIN       large-job placement cutoff, gates (2^18)
+    DPT_SELF_VERIFY           verify-before-serve: auto|0|1 (auto)
+    DPT_SLO_STANDARD_S        standard-class SLO seconds
+    DPT_TTL_*                 per-SLO-class job TTL seconds (DPT_TTL_<CLASS>_S)
+    DPT_JOURNAL_FSYNC         fsync the job journal per append (1)
+    DPT_JOURNAL_COMPACT_EVERY journal compaction cadence, appends (512)
+    DPT_PEER_FETCH_TIMEOUT_MS peer artifact-fetch timeout (5000)
+    DPT_PEAK_TFLOPS           MFU denominator for gflops gauges (1.0)
+    DPT_AUTOSCALE             autoscaler arm: 0|dry|1 (0)
+    DPT_AUTOSCALE_TICK_S      autoscaler control-loop period (2)
+    DPT_AS_MIN_WORKERS        autoscaler floor (1)
+    DPT_AS_MAX_WORKERS        autoscaler ceiling (8)
+    DPT_AS_UP_QUEUE           queue-per-worker upscale threshold (2)
+    DPT_AS_UP_TICKS           consecutive ticks before upscale (2)
+    DPT_AS_DOWN_TICKS         consecutive idle ticks before downscale (5)
+    DPT_AS_UP_COOLDOWN_S      cooldown after an upscale (10)
+    DPT_AS_DOWN_COOLDOWN_S    cooldown after a downscale (30)
+    DPT_AS_SHED_WATERMARK     queue fraction where batch-class sheds (0.9)
+
+Fleet runtime, faults, integrity (runtime/):
+
+    DPT_CALL_TIMEOUT_MS       per-RPC timeout (600000)
+    DPT_RECONNECT_TRIES       dispatcher reconnect attempts (3)
+    DPT_BACKOFF_BASE_MS       reconnect backoff base (50)
+    DPT_BACKOFF_MAX_MS        reconnect backoff cap (2000)
+    DPT_FFT_QUORUM            min workers for a sharded FFT (2)
+    DPT_FFT_TASK_TTL          worker FFT task GC TTL seconds (600)
+    DPT_FFT_DONE_TTL          completed-task retention seconds (60)
+    DPT_FFT_TASK_CAP          max concurrent worker FFT tasks (64)
+    DPT_FLEET_EVAL            distribute round-4 evaluation (1)
+    DPT_BREAKER_K             failures to open a worker breaker (3)
+    DPT_PROBE_BASE_MS         breaker half-open probe base (200)
+    DPT_PROBE_MAX_MS          breaker half-open probe cap (5000)
+    DPT_INTEGRITY             result-integrity plane arm (1)
+    DPT_INTEGRITY_MSM_DUP     MSM duplicate-execution fraction (0.05)
+    DPT_INTEGRITY_NTT_RATE    FFT spot-check sampling rate (1.0)
+    DPT_INTEGRITY_SUBGROUP    subgroup-check returned points (1)
+    DPT_INTEGRITY_REFEREE_MAX max referee recompute size (2048)
+    DPT_JOIN_RETRY_S          membership JOIN retry period (30)
+    DPT_JOIN_TIMEOUT_MS       membership JOIN timeout (10000)
+    DPT_SUP_PROBE_MS          supervisor liveness probe period (500)
+    DPT_SUP_PROBE_TIMEOUT_MS  supervisor probe timeout (3000)
+    DPT_SUP_MISS_BUDGET       missed probes before respawn (3)
+    DPT_SUP_STARTUP_GRACE_S   no-probe grace after spawn
+    DPT_SUP_BACKOFF_BASE_MS   respawn backoff base (250)
+    DPT_SUP_BACKOFF_MAX_MS    respawn backoff cap (10000)
+    DPT_SUP_FLAP_CAP          respawns inside the window before retire (5)
+    DPT_SUP_FLAP_WINDOW_S     flap-counting window (60)
+    DPT_SUP_RETIRE_TIMEOUT_S  graceful retire drain timeout (20)
+    DPT_WORKER_TRACE_CAP      per-worker retained trace spans (32)
+    DPT_FAULTS                chaos fault-injection spec (off unset)
+
+Observability, checkpoints, stores (obs/, store/, top-level):
+
+    DPT_LOG_CAP               structured-log ring capacity (512)
+    DPT_LOG_LEVEL             structured-log emit threshold (debug)
+    DPT_LOG_DIR               mirror structured logs to JSONL files
+    DPT_PROFILE_MS            default on-demand profile window (250)
+    DPT_PROFILE_HZ            host stack-sampler frequency (100)
+    DPT_FLEET_SCRAPE_S        fleet metrics scrape period (5)
+    DPT_CKPT_FSYNC            fsync prover checkpoints (0)
+    DPT_STORE_JAX_SWEEP_S     compile-cache upload sweep period (300)
+    DPT_WARM_SYNC_PREFIXES    store prefixes pulled on warm rejoin
 """
 
 # BLS parameter (the curve family is parameterised by z; z is negative).

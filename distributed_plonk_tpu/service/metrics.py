@@ -488,6 +488,7 @@ class Metrics:
                 "uptime_s": round(uptime, 3),
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
+                # analysis: ok(Histogram.snapshot is a lockless data object)
                 "histograms": {k: h.snapshot()
                                for k, h in sorted(self._hists.items())},
                 "throughput_jobs_per_s": round(done / uptime, 6) if uptime else 0.0,

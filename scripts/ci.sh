@@ -10,10 +10,13 @@
 #                          then NTT + MSM oracle/radix tests — the quick
 #                          pre-commit check for kernel work (~6 min of
 #                          XLA-CPU compiles, no prover/mesh/service)
-#   scripts/ci.sh analyze  static verifier, strict: jaxpr interval bounds over
-#                          the FULL kernel registry + carry contracts + repo
-#                          lints (python -m distributed_plonk_tpu.analysis,
-#                          ~90 s of pure tracing, nothing executes)
+#   scripts/ci.sh analyze  static verifier, strict: jaxpr interval bounds +
+#                          exact value contracts over the FULL kernel
+#                          registry + carry contracts + repo lints (python
+#                          -m distributed_plonk_tpu.analysis, ~2-3 min of
+#                          tracing + exact host evaluation, nothing runs on
+#                          a device; `analyze --changed-only` skips
+#                          unchanged kernel families)
 #   scripts/ci.sh autotune kernel-autotuner smoke tier (ISSUE 14): plan
 #                          store round-trip, fingerprint-mismatch rebuild,
 #                          parity gate vs a lying candidate, env-override
@@ -75,7 +78,11 @@
 #                          (journal AGG recovery)
 cd "$(dirname "$0")/.."
 if [ "$1" = "analyze" ]; then
-  exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q
+  # extra args pass through: `scripts/ci.sh analyze --changed-only` skips
+  # registry families whose kernel modules are unchanged since the last
+  # fully clean run (lints always run)
+  shift
+  exec env JAX_PLATFORMS=cpu python -m distributed_plonk_tpu.analysis --strict -q "$@"
 fi
 if [ "$1" = "benchcheck" ]; then
   exec env JAX_PLATFORMS=cpu python scripts/bench_compare.py

@@ -9,6 +9,13 @@ The mutants mirror the bug classes the verifier exists for:
 - REMOVED LOCK: shared-state write outside the lock scope (AST lint);
 - STALE JIT CACHE KEY: a cached trace depending on a non-key parameter.
 
+analysis/mutants.py carries the VALUE-class corpus on top (dropped
+carry lane, off-by-one limb shift, wrong modulus constant, swapped
+twiddle table — each bounds-clean and rejected only by the value pass —
+plus the lock-order-cycle and undocumented-knob lint sources); the
+harness tests below assert every one of those is still rejected for
+the right reason.
+
 Each must produce >= 1 violation / finding; the real kernels and the
 real repo must produce none (the `--strict` contract ci.sh analyze
 enforces over the FULL registry — here a representative subset keeps
@@ -236,6 +243,64 @@ class Store:
             self.bump()
 '''
     assert not L.lint_source(src)
+
+
+# --- seeded mutant harness (analysis/mutants.py) ------------------------------
+
+from distributed_plonk_tpu.analysis import mutants as M
+
+
+def test_mutant_harness_every_bug_class_rejected():
+    """The ISSUE-19 acceptance gate: >= 5 distinct seeded kernel bug
+    classes, each rejected under --strict by the pass that owns it —
+    and each value-class mutant PROVEN bounds-clean, demonstrating the
+    interval pass's blind spot is real (check_mutants errors on both
+    kinds of drift)."""
+    seen = []
+    errors = M.check_mutants(progress=lambda m, bv, vv: seen.append(m))
+    assert errors == []
+    assert len(seen) >= 5
+    assert len({m.bug for m in seen}) >= 5
+    assert any(m.bug == "dropped-carry-lane" for m in seen)
+
+
+def test_mutant_lock_order_cycle_is_caught():
+    f = L.lint_source(M.LOCK03_MUTANT)
+    assert any(x.code == "LOCK03" and "lock-order cycle" in x.message
+               for x in f)
+    # the same classes with the back edge hoisted out of the lock: the
+    # cycle is broken and LOCK03 must stay silent
+    fixed = L.lint_source(M.LOCK03_FIXED)
+    assert not any(x.code == "LOCK03" for x in fixed)
+
+
+def test_mutant_self_deadlock_is_caught():
+    f = L.lint_source(M.LOCK03_SELF_MUTANT)
+    assert any(x.code == "LOCK03" and "re-acquired" in x.message
+               for x in f)
+    # an RLock is re-entrant: the identical call shape is fine
+    relock = M.LOCK03_SELF_MUTANT.replace("threading.Lock()",
+                                          "threading.RLock()")
+    assert not any(x.code == "LOCK03" for x in L.lint_source(relock))
+
+
+def test_mutant_undocumented_knob_is_caught():
+    f = L.lint_source(M.ENV01_MUTANT, kinds=("env",))
+    assert any(x.code == "ENV01" and "DPT_MUTANT_UNDOCUMENTED_KNOB"
+               in x.message for x in f)
+    # documenting the knob in the glossary clears it
+    assert not L.lint_source(M.ENV01_MUTANT, kinds=("env",),
+                             knob_glossary_doc=M.ENV01_GLOSSARY)
+
+
+def test_wildcard_knob_glossary_entries():
+    doc = "Knobs:\n\n    DPT_TTL_*  per-class TTL overrides.\n"
+    src = 'import os\nv = os.environ.get("DPT_TTL_GOLD_S")\n'
+    assert not L.lint_source(src, kinds=("env",), knob_glossary_doc=doc)
+    other = 'import os\nv = os.environ.get("DPT_OTHER")\n'
+    assert any(x.code == "ENV01" for x in
+               L.lint_source(other, kinds=("env",),
+                             knob_glossary_doc=doc))
 
 
 # --- carry contracts ----------------------------------------------------------

@@ -1,0 +1,73 @@
+"""Wire-tag conformance + control-plane degradation (TAG01's test half).
+
+Every tag in protocol.TAG_NAMES needs a back-compat story: a peer that
+does not implement a tag answers ERR on a connection that keeps serving
+(the fleet's rolling-upgrade invariant, test_fleet_obs carries the
+worker-plane half). This module is the service-control-plane half —
+STATS / STATUS / METRICS / KILL_WORKER / AGG_FETCH raw frames against a
+live ProofService, error paths included — and the parity check that the
+analyzer's AST replica of the tag table (analysis.lint TAG01) never
+drifts from the real protocol.TAG_NAMES.
+"""
+
+import json
+
+from distributed_plonk_tpu.runtime import native, protocol
+from distributed_plonk_tpu.service import ProofService
+
+
+def test_tag_table_parity_with_lint_replica():
+    # the TAG01 lint reads protocol.py by AST (it must not import the
+    # native codec); a new tag that lands in one table but not the other
+    # means the lint silently stops covering it
+    from distributed_plonk_tpu.analysis import lint
+    assert set(lint._protocol_tags()) == set(protocol.TAG_NAMES.values())
+
+
+def test_control_plane_tags_degrade_to_err_and_keep_serving():
+    svc = ProofService(port=0, prover_workers=1).start()
+    conn = native.connect("127.0.0.1", svc.port)
+    try:
+        def ask(tag, payload=b""):
+            conn.send(tag, payload)
+            rtag, body = conn.recv()
+            return rtag, body
+
+        # STATS is a worker-plane tag the service does not implement: it
+        # must degrade to ERR "unknown tag", never kill the connection
+        rtag, body = ask(protocol.STATS)
+        assert rtag == protocol.ERR
+        assert protocol.decode_json(body)["reason"] == "unknown tag"
+
+        # STATUS of a job that does not exist: loud, structured ERR
+        rtag, body = ask(protocol.STATUS,
+                         protocol.encode_json({"job_id": "job-404"}))
+        assert rtag == protocol.ERR
+        assert "unknown job" in protocol.decode_json(body)["reason"]
+
+        # METRICS answers on the same connection the failures rode
+        rtag, body = ask(protocol.METRICS)
+        assert rtag == protocol.OK
+        snap = json.loads(body.decode())
+        assert "queue_depth" in snap["gauges"]
+
+        # KILL_WORKER without --chaos: refused with the arming hint, not
+        # silently ignored (fault injection must never be ambient)
+        rtag, body = ask(protocol.KILL_WORKER,
+                         protocol.encode_json({"worker": 0}))
+        assert rtag == protocol.ERR
+        assert "fault injection disabled" in \
+            protocol.decode_json(body)["reason"]
+
+        # AGG_FETCH of an aggregate that was never built
+        rtag, body = ask(protocol.AGG_FETCH,
+                         protocol.encode_json({"agg_id": "agg-404"}))
+        assert rtag == protocol.ERR
+        assert "no aggregate" in protocol.decode_json(body)["reason"]
+
+        # ...and the connection still serves after five ERR/OK rounds
+        rtag, _ = ask(protocol.PING)
+        assert rtag == protocol.OK
+    finally:
+        conn.close()
+        svc.shutdown()
